@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Headline benchmark: CIFAR10 ResNet-50 training throughput per chip.
+
+BASELINE.md: the reference publishes no numbers; this repo establishes the
+baseline (images/sec/chip on the flagship config, scripts/7.jax_tpu.py:
+ResNet-50, bf16 compute, fused on-device input pipeline, donated state).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is vs BASELINE.json's published number when present, else 1.0
+(this run IS the baseline).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist.data import make_transform
+    from tpu_dist.data.datasets import CIFAR10_MEAN, CIFAR10_STD
+    from tpu_dist.engine.state import TrainState, init_model
+    from tpu_dist.engine.steps import make_train_step
+    from tpu_dist.models import create_model
+    from tpu_dist.ops import make_optimizer
+    from tpu_dist.parallel.mesh import batch_sharding, make_mesh, replicated
+
+    n_chips = jax.device_count()
+    per_chip_batch = int(os.environ.get("BENCH_PER_CHIP_BATCH", "512"))
+    batch = per_chip_batch * n_chips
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+
+    mesh = make_mesh()
+    model = create_model("resnet50", num_classes=10, dtype=jnp.bfloat16)
+    params, batch_stats = init_model(model, jax.random.PRNGKey(0), (2, 32, 32, 3))
+    tx = make_optimizer(0.1, 0.9, 1e-4, steps_per_epoch=100)
+    state = jax.device_put(TrainState.create(params, batch_stats, tx),
+                           replicated(mesh))
+    transform = make_transform(CIFAR10_MEAN, CIFAR10_STD, dtype=jnp.bfloat16)
+    step = make_train_step(model, tx, transform, mesh)
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 255, (batch, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, (batch,)).astype(np.int32)
+    sh = batch_sharding(mesh)
+    images = jax.device_put(images, sh)
+    labels = jax.device_put(labels, sh)
+    key = jax.random.PRNGKey(0)
+
+    # warmup: compile + 3 steps
+    for _ in range(3):
+        state, metrics = step(state, images, labels, key)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, images, labels, key)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    ips_per_chip = ips / n_chips
+
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BASELINE.json")) as f:
+            baseline = json.load(f).get("published", {}).get(
+                "cifar10_resnet50_images_per_sec_per_chip")
+    except Exception:
+        pass
+    vs = ips_per_chip / baseline if baseline else 1.0
+
+    print(json.dumps({
+        "metric": "cifar10_resnet50_images_per_sec_per_chip",
+        "value": round(ips_per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

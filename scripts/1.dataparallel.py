@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Variant 1 — single-process multi-device (nn.DataParallel equivalent).
+
+Reference: 1.dataparallel.py — one process drives 4 GPUs via scatter/gather
+(reference 1.dataparallel.py:109), global batch NOT pre-divided
+(reference 1.dataparallel.py:140-144), defaults resnet101 / 5 epochs / batch
+3200 / CIFAR10 (reference 1.dataparallel.py:33,42,44).
+
+TPU-native: one process already addresses every local chip; `jit` over a 1-D
+data mesh IS DataParallel without the scatter/gather host bottleneck (SURVEY.md
+§7 'DataParallel analog'). No launcher, no rendezvous.
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from tpu_dist.configs import TrainConfig, parse_config
+from tpu_dist.engine import Trainer
+
+DEFAULTS = TrainConfig(arch="resnet101", epochs=5, batch_size=3200,
+                       dataset="cifar10", variant="jit",
+                       log_csv="dataparallel.csv")
+
+if __name__ == "__main__":
+    cfg = parse_config(defaults=DEFAULTS, description=__doc__)
+    best = Trainer(cfg).fit()
+    print(f"best_acc1 {best * 100:.3f}")

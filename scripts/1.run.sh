@@ -1,0 +1,3 @@
+#!/bin/bash
+# variant 1: single process, all local TPU chips (reference 1.run.sh:3)
+python scripts/1.dataparallel.py "$@"

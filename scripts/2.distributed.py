@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Variant 2 — launcher-driven multi-process DDP (torch.distributed.launch equiv).
+
+Reference: 2.distributed.py — `python -m torch.distributed.launch
+--nproc_per_node=4` spawns one process per GPU; env:// rendezvous; per-process
+batch division; DDP bucketed gradient allreduce (reference 2.distributed.py:
+98,113,114; 2.run.sh:5).
+
+TPU-native: one process per HOST (each process owns all its chips);
+`jax.distributed.initialize` over DCN replaces env:// rendezvous
+(TPU_DIST_COORDINATOR / TPU_DIST_NUM_PROCESSES / TPU_DIST_PROCESS_ID env, set
+by scripts/2.run.sh); the gradient all-reduce is inserted by XLA exactly where
+DDP's NCCL allreduce fired. Defaults mirror the reference: resnet18 / 2 epochs
+(reference 2.distributed.py:30,39).
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from tpu_dist.configs import TrainConfig, parse_config
+from tpu_dist.engine import Trainer
+from tpu_dist.parallel import launch
+
+DEFAULTS = TrainConfig(arch="resnet18", epochs=2, batch_size=3200,
+                       dataset="cifar10", variant="jit")
+
+if __name__ == "__main__":
+    cfg = parse_config(defaults=DEFAULTS, description=__doc__)
+    info = launch.initialize()
+    print(f"[proc {info.process_id}/{info.num_processes}] rendezvous={info.method}")
+    best = Trainer(cfg).fit()
+    print(f"best_acc1 {best * 100:.3f}")

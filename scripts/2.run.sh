@@ -1,0 +1,7 @@
+#!/bin/bash
+# variant 2: launcher-driven multi-host (reference 2.run.sh:5 torch.distributed.launch).
+# One process per host; HOSTS="host0 host1 ..." COORD=host0:8476 srun/ssh-style launch:
+#   TPU_DIST_COORDINATOR=$COORD TPU_DIST_NUM_PROCESSES=$N TPU_DIST_PROCESS_ID=$i \
+#     python scripts/2.distributed.py "$@"   # on each host i
+# Single-host run:
+python scripts/2.distributed.py "$@"

@@ -1,0 +1,4 @@
+#!/bin/bash
+# variant 3: in-process spawn (reference 3.run.sh:3). TPU: nprocs=1 is canonical;
+# TPU_DIST_NPROCS_SPAWN=4 forks a loopback-TCP CPU simulation of 4 hosts.
+python scripts/3.multiprocessing_spawn.py "$@"

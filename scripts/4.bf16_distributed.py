@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Variant 4 — mixed precision (apex AMP + apex DDP equivalent).
+
+Reference: 4.apex_distributed2.py — `amp.initialize(model, optimizer)` +
+`amp.scale_loss` dynamic loss scaling + apex DistributedDataParallel
+(reference 4.apex_distributed2.py:177-178,289-290). The reference's CUDA-
+stream prefetcher variant (4.apex_distributed.py:80-133) was disabled as
+buggy upstream (4.apex_distributed2.py:80).
+
+TPU-native: bf16 has fp32's exponent range, so mixed precision is a dtype
+policy with NO loss scaling (--precision bf16; SURVEY.md §2b apex row).
+Dynamic loss scaling is still available (--loss-scale 32768) for apex-semantics
+parity experiments. The prefetcher role is filled by the double-buffered
+device_put pipeline, enabled for every variant (tpu_dist/data/loader.py).
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from tpu_dist.configs import TrainConfig, parse_config
+from tpu_dist.engine import Trainer
+from tpu_dist.parallel import launch
+
+DEFAULTS = TrainConfig(arch="resnet18", epochs=10, batch_size=3200,
+                       dataset="cifar10", variant="jit", precision="bf16")
+
+if __name__ == "__main__":
+    cfg = parse_config(defaults=DEFAULTS, description=__doc__)
+    info = launch.initialize()
+    print(f"[proc {info.process_id}/{info.num_processes}] precision={cfg.precision}")
+    best = Trainer(cfg).fit()
+    print(f"best_acc1 {best * 100:.3f}")

@@ -1,0 +1,3 @@
+#!/bin/bash
+# variant 4: bf16 mixed precision (reference 4.run.sh:3 apex AMP)
+python scripts/4.bf16_distributed.py "$@"

@@ -1,0 +1,3 @@
+#!/bin/bash
+# variant 5.2 fp16: bf16-compressed gradient allreduce (reference 5.2.run.mnist.fp16.sh:3)
+python scripts/5.2.mnist.py --grad-compression bf16 "$@"

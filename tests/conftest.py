@@ -1,0 +1,20 @@
+"""Test env: 8 virtual CPU devices in one process.
+
+SURVEY.md §4: the reference has no tests; our distributed logic is exercised
+without a pod via XLA host-platform virtual devices — the clean analog of
+"multi-node without a real cluster".
+MUST be set before jax initializes, hence conftest import time.
+"""
+
+import os
+
+# The image's sitecustomize pre-imports jax and registers the axon TPU plugin
+# (JAX_PLATFORMS=axon), so env vars are too late here; jax.config still works
+# because no backend has been initialized yet. Tests run on 8 virtual CPU
+# devices unless TPU_DIST_TEST_TPU=1 opts into the real chip.
+if os.environ.get("TPU_DIST_TEST_TPU") != "1":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+os.environ.setdefault("JAX_ENABLE_X64", "0")

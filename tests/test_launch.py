@@ -1,0 +1,65 @@
+"""Launch/rendezvous discovery tests (C23/C25 — the four rendezvous flavors)."""
+
+import os
+from unittest import mock
+
+from tpu_dist.parallel.launch import _slurm_first_host, detect_launch
+
+
+def test_local_default():
+    with mock.patch.dict(os.environ, {}, clear=True):
+        info = detect_launch()
+        assert info.method == "local"
+        assert info.num_processes == 1 and info.process_id == 0
+
+
+def test_env_rendezvous():
+    env = {"TPU_DIST_COORDINATOR": "10.0.0.1:8476",
+           "TPU_DIST_NUM_PROCESSES": "4", "TPU_DIST_PROCESS_ID": "2"}
+    with mock.patch.dict(os.environ, env, clear=True):
+        info = detect_launch()
+        assert info.method == "env"
+        assert info.coordinator == "10.0.0.1:8476"
+        assert info.num_processes == 4 and info.process_id == 2
+
+
+def test_explicit_args_override_env():
+    with mock.patch.dict(os.environ, {}, clear=True):
+        info = detect_launch("h:1", 2, 1)
+        assert (info.coordinator, info.num_processes, info.process_id) == \
+            ("h:1", 2, 1)
+
+
+def test_slurm_rendezvous():
+    # reference 6.distributed_slurm_main.py:89-94 rank math
+    env = {"SLURM_PROCID": "3", "SLURM_NPROCS": "4",
+           "SLURM_JOB_NODELIST": "tpu-node[01-04]"}
+    with mock.patch.dict(os.environ, env, clear=True):
+        info = detect_launch()
+        assert info.method == "slurm"
+        assert info.process_id == 3 and info.num_processes == 4
+        assert info.coordinator.startswith("tpu-node01:")
+
+
+def test_slurm_nodelist_expansion():
+    assert _slurm_first_host("host1") == "host1"
+    assert _slurm_first_host("node[3-7]") == "node3"
+    assert _slurm_first_host("gpu[11,13]") == "gpu11"
+    assert _slurm_first_host("a01,a02") == "a01"
+
+
+def test_single_slurm_proc_is_local():
+    env = {"SLURM_PROCID": "0", "SLURM_NPROCS": "1"}
+    with mock.patch.dict(os.environ, env, clear=True):
+        assert detect_launch().method == "local"
+
+
+def test_bool_flags_support_no_form():
+    """BooleanOptionalAction: True-defaulted variant flags stay overridable."""
+    from tpu_dist.configs import TrainConfig, parse_config
+
+    d = TrainConfig(lr_scale_by_world=True)
+    cfg = parse_config(["--no-lr-scale-by-world"], defaults=d)
+    assert cfg.lr_scale_by_world is False
+    cfg2 = parse_config([], defaults=d)
+    assert cfg2.lr_scale_by_world is True

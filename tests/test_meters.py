@@ -1,0 +1,61 @@
+"""Unit tests for meters/accuracy (reference C17/C18 semantics)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist.utils.meters import (AverageMeter, ProgressMeter, accuracy,
+                                   correct_counts, topk_accuracy)
+
+
+def test_average_meter_running_avg():
+    m = AverageMeter("Loss", ":.4f")
+    m.update(2.0, n=2)
+    m.update(4.0, n=2)
+    assert m.val == 4.0
+    assert m.sum == 12.0
+    assert m.count == 4
+    assert m.avg == 3.0
+
+
+def test_average_meter_reset():
+    m = AverageMeter("x")
+    m.update(5.0)
+    m.reset()
+    assert m.avg == 0.0 and m.count == 0
+
+
+def test_progress_meter_format():
+    m = AverageMeter("Loss", ":.2f")
+    m.update(1.5)
+    lines = []
+    p = ProgressMeter(100, [m], prefix="Epoch: [3]")
+    p.display(7, printer=lines.append)
+    assert lines == ["Epoch: [3][  7/100]\tLoss 1.50 (1.50)"]
+
+
+def test_simplified_accuracy_matches_reference_semantics():
+    # reference returns top-1 twice (1.dataparallel.py:339-364, README_EN.md:654)
+    logits = jnp.array([[1.0, 2.0, 0.0], [3.0, 0.0, 1.0]])
+    target = jnp.array([1, 2])
+    a1, a5 = accuracy(logits, target)
+    assert float(a1) == 0.5
+    assert float(a5) == 0.5
+
+
+def test_topk_accuracy_percent():
+    logits = jnp.array([[0.9, 0.5, 0.1, 0.0, 0.0],
+                        [0.1, 0.2, 0.9, 0.0, 0.0]])
+    target = jnp.array([1, 0])
+    top1, top2 = topk_accuracy(logits, target, topk=(1, 2))
+    assert float(top1) == 0.0
+    assert float(top2) == 50.0  # sample 0: class 1 is 2nd
+
+
+def test_correct_counts_are_sums_not_fractions():
+    logits = jnp.array([[9.0, 1.0, 0.0],   # pred 0, target 0 -> top1 hit
+                        [1.0, 9.0, 0.0],   # pred 1, target 1 -> top1 hit
+                        [9.0, 5.0, 0.0]])  # pred 0, target 1 -> top2 only
+    target = jnp.array([0, 1, 1])
+    c1, c2 = correct_counts(logits, target, topk=(1, 2))
+    assert float(c1) == 2.0
+    assert float(c2) == 3.0

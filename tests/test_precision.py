@@ -1,0 +1,46 @@
+"""Precision policy + dynamic loss scaling (apex AMP semantics, C11/C12)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist.ops.precision import (LossScaleState, make_policy, scale_loss,
+                                    unscale_and_update)
+
+
+def test_policy_dtypes():
+    assert make_policy("fp32").compute_dtype == jnp.float32
+    assert make_policy("bf16").compute_dtype == jnp.bfloat16
+    assert make_policy("bf16").param_dtype == jnp.float32        # O1-ish
+    assert make_policy("bf16_params").param_dtype == jnp.bfloat16  # O2-ish
+    with pytest.raises(ValueError):
+        make_policy("fp16")
+
+
+def test_no_scaling_passthrough():
+    grads = {"w": jnp.ones((2,))}
+    out, state, finite = unscale_and_update(grads, None)
+    assert state is None and bool(finite)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.ones((2,)))
+
+
+def test_loss_scale_roundtrip():
+    s = LossScaleState.create(1024.0)
+    loss = scale_loss(jnp.float32(2.0), s)
+    assert float(loss) == 2048.0
+    grads = {"w": jnp.full((3,), 1024.0)}
+    out, s2, finite = unscale_and_update(grads, s)
+    assert bool(finite)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.ones((3,)))
+
+
+def test_loss_scale_halves_on_overflow_and_grows():
+    s = LossScaleState.create(1024.0)
+    bad = {"w": jnp.array([jnp.inf, 1.0])}
+    _, s2, finite = unscale_and_update(bad, s)
+    assert not bool(finite)
+    assert float(s2.scale) == 512.0  # apex: halve on non-finite
+    good = {"w": jnp.array([1.0, 1.0])}
+    _, s3, finite = unscale_and_update(good, s2, growth_interval=1)
+    assert bool(finite)
+    assert float(s3.scale) == 1024.0  # doubled after growth_interval good steps

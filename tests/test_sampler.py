@@ -1,0 +1,84 @@
+"""DistributedSampler-equivalence tests (reference C5 semantics)."""
+
+import numpy as np
+import pytest
+
+from tpu_dist.data.sampler import DistributedSampler
+
+
+def _shards(n, world, **kw):
+    return [DistributedSampler(n, world, r, **kw).indices() for r in range(world)]
+
+
+def test_shards_partition_padded_dataset():
+    n, world = 103, 4
+    shards = _shards(n, world, shuffle=False)
+    allidx = np.concatenate(shards)
+    # every original index appears at least once (wrap-around padding)
+    assert set(range(n)) <= set(allidx.tolist())
+    # equal shard sizes (static shapes requirement)
+    assert len({len(s) for s in shards}) == 1
+
+
+def test_strided_assignment_matches_torch_semantics():
+    # torch DistributedSampler: rank r takes indices[r::world]
+    n, world = 16, 4
+    shards = _shards(n, world, shuffle=False)
+    for r in range(world):
+        np.testing.assert_array_equal(shards[r], np.arange(n)[r::world])
+
+
+def test_set_epoch_reshuffles_deterministically():
+    s = DistributedSampler(100, 2, 0, shuffle=True, seed=5)
+    s.set_epoch(0)
+    e0 = s.indices()
+    s.set_epoch(1)
+    e1 = s.indices()
+    s.set_epoch(0)
+    e0b = s.indices()
+    assert not np.array_equal(e0, e1)       # reshuffled per epoch
+    np.testing.assert_array_equal(e0, e0b)  # deterministic per (seed, epoch)
+
+
+def test_same_epoch_consistent_across_ranks():
+    # both ranks must derive the SAME permutation or shards overlap/miss
+    a = DistributedSampler(50, 2, 0, shuffle=True, seed=9)
+    b = DistributedSampler(50, 2, 1, shuffle=True, seed=9)
+    a.set_epoch(3), b.set_epoch(3)
+    union = set(a.indices().tolist()) | set(b.indices().tolist())
+    assert union == set(range(50))
+    assert len(set(a.indices().tolist()) & set(b.indices().tolist())) == 0
+
+
+def test_batch_padding_gives_full_batches():
+    s = DistributedSampler(1000, 4, 0, shuffle=True, batch_size=48)
+    assert s.num_samples % 48 == 0
+
+
+def test_drop_last():
+    s = DistributedSampler(103, 4, 0, shuffle=False, batch_size=8, drop_last=True)
+    assert s.total_size == 96
+    assert s.num_samples == 24
+
+
+def test_invalid_rank_raises():
+    with pytest.raises(ValueError):
+        DistributedSampler(10, 2, 2)
+
+
+def test_valid_mask_marks_padding_exactly_once():
+    n, world, bs = 103, 4, 8
+    total_valid = 0
+    for r in range(world):
+        s = DistributedSampler(n, world, r, shuffle=False, batch_size=bs)
+        idx, valid = s.indices_with_valid()
+        assert len(idx) == len(valid)
+        total_valid += int(valid.sum())
+    # across all ranks, exactly the n real samples are marked valid
+    assert total_valid == n
+
+
+def test_valid_mask_all_true_when_no_padding():
+    s = DistributedSampler(64, 4, 0, shuffle=True, batch_size=16)
+    _, valid = s.indices_with_valid()
+    assert valid.all()

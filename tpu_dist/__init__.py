@@ -1,0 +1,17 @@
+"""tpu_dist — a TPU-native distributed-training framework.
+
+Re-implements, TPU-first (JAX/XLA/pjit/shard_map/Pallas), the capabilities of the
+reference cookbook ``Xianchao-Wu/pytorch-distributed`` (six data-parallel launcher /
+backend variants training image classifiers with distributed evaluation, mixed
+precision, checkpointing and metering — see /root/repo/SURVEY.md).
+
+Unlike the reference's six flat scripts that each inline the same ~200 lines
+(SURVEY.md §1), tpu_dist is a layered package; the cookbook surface survives as thin
+scripts in ``scripts/`` that all drive one engine with different launch/parallelism
+configs — mirroring the fact that the reference variants differ only in their
+launcher/engine wrap lines (reference: 2.distributed.py:114, 5.horovod_distributed.py:125).
+"""
+
+__version__ = "0.1.0"
+
+from tpu_dist import configs  # noqa: F401

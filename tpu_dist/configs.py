@@ -1,0 +1,111 @@
+"""Config / CLI layer (reference component C1).
+
+The reference repeats an ~45-line argparse block in every script
+(reference: 1.dataparallel.py:26-70, 2.distributed.py:25-68,
+5.2.horovod_pytorch_mnist.py:11-33, 6.distributed_slurm_main.py:27-70).
+Here the flags live once as a dataclass; each cookbook script builds its parser
+from it and overrides per-variant defaults (e.g. variant 1 defaults to
+resnet101 / 5 epochs, variants 2-6 to resnet18 — reference 1.dataparallel.py:33,
+2.distributed.py:30).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+@dataclass
+class TrainConfig:
+    """All knobs of the reference scripts, plus TPU-native ones.
+
+    Reference flag provenance is noted per field; TPU-only fields are marked.
+    """
+
+    # -- data (reference 1.dataparallel.py:27-31)
+    data: str = "data"                 # dataset root dir
+    dataset: str = "cifar10"           # cifar10 | mnist | imagenet | synthetic
+    workers: int = 4                   # loader worker threads (host-side)
+
+    # -- model (reference 1.dataparallel.py:32-38)
+    arch: str = "resnet18"
+    pretrained: bool = False
+
+    # -- schedule (reference 1.dataparallel.py:39-56)
+    epochs: int = 10
+    start_epoch: int = 0
+    batch_size: int = 3200             # GLOBAL batch (divided per process/device)
+    lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    lr_step_epochs: int = 30           # x0.1 every N epochs (1.dataparallel.py:332-336)
+    lr_scale_by_world: bool = False    # horovod-style lr x world_size (5.2...py:159-171)
+
+    # -- loop control (reference 1.dataparallel.py:57-70)
+    print_freq: int = 10
+    evaluate: bool = False
+    seed: Optional[int] = None
+    resume: str = ""                   # TPU build adds REAL resume (reference has none,
+                                       # SURVEY.md §5 checkpoint)
+    checkpoint_dir: str = "checkpoints"
+
+    # -- precision (reference variant 4 apex AMP -> XLA bf16; SURVEY.md §2b apex row)
+    precision: str = "fp32"            # fp32 | bf16 | bf16_params
+    loss_scale: Optional[float] = None # only meaningful if emulating fp16 semantics
+    grad_compression: str = "none"     # none | bf16  (hvd.Compression.fp16-equiv,
+                                       # reference 5.horovod_distributed.py:123-125)
+
+    # -- distribution (reference C5/C6/C25 + TPU mesh)
+    variant: str = "jit"               # engine flavor tag for logging only
+    mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) dp; (4,2) dp x model
+    mesh_axes: Sequence[str] = ("data",)
+    gradient_predivide_factor: float = 1.0      # reference 5.2...py:185
+    adasum: bool = False                        # reference 5.2...py:184 (mapped to
+                                                # plain mean on TPU; doc'd delta)
+
+    # -- observability (reference C21/C22)
+    log_csv: str = ""                  # per-epoch [start, seconds] CSV if set
+    profile_dir: str = ""              # jax.profiler trace dir if set
+
+    # -- synthetic-data knobs (TPU-only: zero-egress envs can't download datasets)
+    synth_train_size: int = 50000
+    synth_val_size: int = 10000
+
+    def scaled_lr(self, world_size: int) -> float:
+        """Horovod lr scaling rule (reference 5.2.horovod_pytorch_mnist.py:159-171)."""
+        return self.lr * world_size if self.lr_scale_by_world else self.lr
+
+
+def add_args(parser: argparse.ArgumentParser, defaults: TrainConfig) -> None:
+    """Register every TrainConfig field as a --flag (reference C1 parity)."""
+    for f in dataclasses.fields(TrainConfig):
+        name = "--" + f.name.replace("_", "-")
+        default = getattr(defaults, f.name)
+        if f.type == "bool" or isinstance(default, bool):
+            # BooleanOptionalAction: --flag / --no-flag, so variant defaults
+            # of True (e.g. 5.2's lr_scale_by_world) stay overridable
+            parser.add_argument(name, action=argparse.BooleanOptionalAction,
+                                default=default)
+        elif f.name == "mesh_shape":
+            parser.add_argument(name, type=lambda s: tuple(int(x) for x in s.split(",")),
+                                default=default)
+        elif f.name == "mesh_axes":
+            parser.add_argument(name, type=lambda s: tuple(s.split(",")), default=default)
+        else:
+            typ = type(default) if default is not None else str
+            if f.name in ("seed", "loss_scale"):
+                typ = float if f.name == "loss_scale" else int
+            parser.add_argument(name, type=typ, default=default)
+
+
+def parse_config(argv: Optional[Sequence[str]] = None,
+                 defaults: Optional[TrainConfig] = None,
+                 description: str = "tpu_dist training") -> TrainConfig:
+    defaults = defaults or TrainConfig()
+    parser = argparse.ArgumentParser(description=description)
+    add_args(parser, defaults)
+    ns = parser.parse_args(argv)
+    return TrainConfig(**{f.name: getattr(ns, f.name)
+                          for f in dataclasses.fields(TrainConfig)})

@@ -1,0 +1,84 @@
+"""ImageFolder-style ImageNet loading (reference component C4, variant 6).
+
+The reference uses ``datasets.ImageFolder`` with RandomResizedCrop/Flip for
+ImageNet (reference 6.distributed_slurm_main.py:130-159). Here: a lazy dataset
+scanning ``root/{train,val}/<class>/<img>`` that decodes JPEGs per batch on the
+host (PIL) and resizes to 224x224; flip/crop augmentation runs on device like
+the other datasets (tpu_dist.data.pipeline).
+
+Decode throughput on a 1-core host will not feed a TPU pod — that is a known
+host-input-pipeline limit (SURVEY.md §7 'Host input pipeline throughput');
+the per-batch decode is threaded and the device prefetcher double-buffers, so
+the structure is right even where this container's CPU is not.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tpu_dist.data.datasets import ArrayDataset, IMAGENET_MEAN, IMAGENET_STD
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+class ImageFolderDataset:
+    """Lazy ImageFolder with the ArrayDataset batch protocol (get_batch)."""
+
+    def __init__(self, split_dir: str, size: int = 224, workers: int = 8,
+                 name: str = "imagefolder"):
+        classes = sorted(d for d in os.listdir(split_dir)
+                         if os.path.isdir(os.path.join(split_dir, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(split_dir, c)
+            for fn in sorted(os.listdir(cdir)):
+                if os.path.splitext(fn)[1].lower() in _EXTS:
+                    self.samples.append((os.path.join(cdir, fn), self.class_to_idx[c]))
+        if not self.samples:
+            raise FileNotFoundError(f"no images under {split_dir}")
+        self.labels = np.array([s[1] for s in self.samples], np.int32)
+        self.size = size
+        self.num_classes = len(classes)
+        self.mean, self.std = IMAGENET_MEAN, IMAGENET_STD
+        self.name = name
+        self._pool = ThreadPoolExecutor(max_workers=workers)
+
+    def __len__(self):
+        return len(self.samples)
+
+    @property
+    def image_shape(self) -> Tuple[int, int, int]:
+        return (self.size, self.size, 3)
+
+    def _decode(self, idx: int) -> np.ndarray:
+        from PIL import Image
+        path, _ = self.samples[idx]
+        with Image.open(path) as im:
+            im = im.convert("RGB")
+            # resize shorter side to size*1.14 then center crop (device handles
+            # random crop jitter); matches the reference's val transform scale
+            # (6.distributed_slurm_main.py:148-159 Resize(256)/CenterCrop(224)).
+            w, h = im.size
+            scale = (self.size * 256 // 224) / min(w, h)
+            im = im.resize((max(1, round(w * scale)), max(1, round(h * scale))))
+            arr = np.asarray(im, np.uint8)
+        top = (arr.shape[0] - self.size) // 2
+        left = (arr.shape[1] - self.size) // 2
+        return arr[top:top + self.size, left:left + self.size]
+
+    def get_batch(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        imgs = list(self._pool.map(self._decode, [int(i) for i in indices]))
+        return np.stack(imgs), self.labels[indices]
+
+
+def load_imagefolder(root: str) -> Optional[Tuple[ImageFolderDataset, ImageFolderDataset]]:
+    tr_dir, va_dir = os.path.join(root, "train"), os.path.join(root, "val")
+    if not (os.path.isdir(tr_dir) and os.path.isdir(va_dir)):
+        return None
+    return (ImageFolderDataset(tr_dir, name="imagenet-train"),
+            ImageFolderDataset(va_dir, name="imagenet-val"))

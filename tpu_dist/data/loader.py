@@ -1,0 +1,127 @@
+"""Host-side batch loader + device prefetcher (reference C4/C13).
+
+Replaces two reference mechanisms TPU-first:
+
+* the ``DataLoader(num_workers=...)`` host pipeline (reference
+  2.distributed.py:137-160) — here a background thread assembles uint8 numpy
+  batches from the sampler's index stream (decode/gather overlapped with the
+  device step);
+* the CUDA-stream ``data_prefetcher`` that overlapped H2D copy + normalize
+  with compute and which upstream disabled as buggy (reference
+  4.apex_distributed.py:80-133, 4.apex_distributed2.py:80) — here
+  :func:`prefetch_to_device` keeps N batches in flight with
+  ``jax.device_put`` onto the step's input sharding. JAX transfers are async
+  (dispatch returns immediately), so compute/copy overlap falls out of the
+  runtime instead of hand-managed streams; normalization happens on device
+  inside the jitted step (tpu_dist.data.pipeline).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from tpu_dist.data.sampler import DistributedSampler
+
+
+class DataLoader:
+    """Yields (images_u8, labels_i32) numpy batches for this process's shard."""
+
+    def __init__(self, dataset, sampler: DistributedSampler, batch_size: int,
+                 workers: int = 2, queue_depth: int = 4,
+                 emit_valid: bool = False):
+        if sampler.batch_size not in (None, batch_size):
+            raise ValueError("sampler.batch_size disagrees with loader batch_size")
+        self.dataset = dataset
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.queue_depth = queue_depth
+        # emit_valid: also yield a float32 validity mask distinguishing real
+        # samples from the sampler's wrap-around padding (exact eval metrics)
+        self.emit_valid = emit_valid
+
+    def __len__(self) -> int:
+        return self.sampler.num_samples // self.batch_size
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        idx, valid = self.sampler.indices_with_valid()
+        nbatches = len(self)
+        q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            """Queue-put that aborts when the consumer is gone (never parks
+            forever on a full queue after the consumer abandoned iteration)."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for b in range(nbatches):
+                    sel = slice(b * self.batch_size, (b + 1) * self.batch_size)
+                    batch = self.dataset.get_batch(idx[sel])
+                    if self.emit_valid:
+                        batch = (*batch, valid[sel].astype(np.float32))
+                    if not _put(batch):
+                        return
+                _put(None)
+            except BaseException as e:  # surface worker errors on the consumer
+                _put(e)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+
+def prefetch_to_device(iterator, sharding=None, size: int = 2):
+    """Keep ``size`` device-put batches in flight (C13 equivalent, stream-free).
+
+    ``sharding`` is a ``jax.sharding.Sharding`` describing the step function's
+    input layout; batches land pre-sharded so the jitted step never re-lays
+    data out. In multi-process runs each process feeds only its OWN sampler
+    shard, so the global batch is assembled with
+    ``jax.make_array_from_process_local_data`` (a bare device_put would treat
+    the local shard as the whole global array and silently drop the other
+    processes' data — the multi-controller JAX pitfall).
+    """
+    buf = []
+    multiprocess = jax.process_count() > 1
+
+    def put(batch):
+        if sharding is None:
+            return jax.tree.map(jax.device_put, batch)
+        if multiprocess:
+            return tuple(
+                jax.make_array_from_process_local_data(sharding, arr)
+                for arr in batch)
+        return jax.device_put(batch, sharding)
+    it = iter(iterator)
+    try:
+        for _ in range(size):
+            buf.append(put(next(it)))
+    except StopIteration:
+        pass
+    while buf:
+        yield buf.pop(0)
+        try:
+            buf.append(put(next(it)))
+        except StopIteration:
+            pass

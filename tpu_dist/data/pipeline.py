@@ -1,0 +1,71 @@
+"""On-device input transforms (reference C4's transforms + C13's GPU normalize).
+
+The reference normalizes on CPU via ToTensor+Normalize (reference
+2.distributed.py:127-136) or on GPU in the prefetcher's side stream with
+x255 mean/std (reference 4.apex_distributed.py:86-99). TPU-first: the step
+function receives raw uint8 NHWC batches and this module's pure functions run
+*inside jit*, so uint8->bf16 conversion, normalize, and augmentation all fuse
+into the forward pass (one HBM read, VPU elementwise — no host preprocessing
+bottleneck).
+
+Augmentation mirrors the reference per dataset:
+* CIFAR10/MNIST train: normalize only (reference 2.distributed.py:127-136 uses
+  no augmentation);
+* ImageNet train: random crop jitter + horizontal flip ≈ RandomResizedCrop/
+  RandomHorizontalFlip (reference 6.distributed_slurm_main.py:130-141); the
+  host decode already center-crops with a 256/224 margin, so the on-device
+  jitter shifts within that margin with static shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def normalize(images_u8: jax.Array, mean: jax.Array, std: jax.Array,
+              dtype=jnp.float32) -> jax.Array:
+    """uint8 (B,H,W,C) -> normalized float, matching torchvision ToTensor+Normalize."""
+    x = images_u8.astype(dtype) / jnp.asarray(255.0, dtype)
+    return (x - mean.astype(dtype)) / std.astype(dtype)
+
+
+def random_flip(images: jax.Array, key: jax.Array) -> jax.Array:
+    """Per-sample horizontal flip (reference 6...py:137 RandomHorizontalFlip)."""
+    flip = jax.random.bernoulli(key, 0.5, (images.shape[0], 1, 1, 1))
+    return jnp.where(flip, images[:, :, ::-1, :], images)
+
+
+def random_shift(images: jax.Array, key: jax.Array, max_shift: int = 4) -> jax.Array:
+    """Static-shape random translation via pad+dynamic_slice (crop-jitter).
+
+    The TPU-native stand-in for RandomResizedCrop's translation component
+    (reference 6...py:136): per-batch shift keeps shapes static for XLA.
+    """
+    if max_shift == 0:
+        return images
+    b, h, w, c = images.shape
+    padded = jnp.pad(images, ((0, 0), (max_shift, max_shift),
+                              (max_shift, max_shift), (0, 0)), mode="edge")
+    dy, dx = jax.random.randint(key, (2,), 0, 2 * max_shift + 1)
+    return jax.lax.dynamic_slice(padded, (0, dy, dx, 0), (b, h, w, c))
+
+
+def make_transform(mean, std, augment: bool = False, max_shift: int = 4,
+                   dtype=jnp.float32):
+    """Returns transform(images_u8, key|None) for use inside the jitted step."""
+    mean = jnp.asarray(mean)
+    std = jnp.asarray(std)
+
+    def transform(images_u8: jax.Array, key: Optional[jax.Array] = None) -> jax.Array:
+        x = normalize(images_u8, mean, std, dtype)
+        if augment and key is not None:
+            k1, k2 = jax.random.split(key)
+            x = random_shift(x, k1, max_shift)
+            x = random_flip(x, k2)
+        return x
+
+    return transform

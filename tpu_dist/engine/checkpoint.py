@@ -1,0 +1,67 @@
+"""Checkpoint save/RESUME (reference component C20, strictly extended).
+
+The reference only saves — ``torch.save({epoch, arch, state_dict, best_acc1})``
+plus a ``model_best`` copy, rank-0-guarded in variants 2-5 (reference
+1.dataparallel.py:283-288, 2.distributed.py:182-189) and unguarded (racy) in
+variant 6 (reference 6.distributed_slurm_main.py:190). It has **no load path
+at all** (zero torch.load in the repo — SURVEY.md §5 'Checkpoint / resume').
+
+tpu_dist does what the reference should have done:
+* process-0-only writes (atomic: tmp file + rename);
+* full TrainState (params, BN stats, optimizer state, step, loss scale)
+  serialized with flax msgpack after gathering to host;
+* REAL resume: restore into a template state, continuing epoch/step/best;
+* ``model_best`` copy on improvement, same filename convention
+  (``{arch}-checkpoint.msgpack`` ≈ the reference's arch-prefixed .pth.tar).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def _to_host(tree):
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
+                    arch: str, is_best: bool) -> Optional[str]:
+    """Process-0 atomic save; returns path (None on non-zero processes)."""
+    if jax.process_index() != 0:
+        return None
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, f"{arch}-checkpoint.msgpack")
+    meta = {"epoch": epoch, "arch": arch, "best_acc1": float(best_acc1),
+            "step": int(jax.device_get(state.step))}
+    blob = serialization.to_bytes(_to_host(state))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    with open(path + ".json", "w") as f:
+        json.dump(meta, f)
+    if is_best:
+        # reference shutil.copyfile to 'model_best' (1.dataparallel.py:287-288)
+        shutil.copyfile(path, os.path.join(ckpt_dir, f"{arch}-model_best.msgpack"))
+        shutil.copyfile(path + ".json",
+                        os.path.join(ckpt_dir, f"{arch}-model_best.msgpack.json"))
+    return path
+
+
+def load_checkpoint(path: str, template_state) -> Tuple[Any, Dict]:
+    """Restore a TrainState saved by save_checkpoint into template's structure."""
+    with open(path, "rb") as f:
+        state = serialization.from_bytes(template_state, f.read())
+    meta_path = path + ".json"
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    return state, meta

@@ -1,0 +1,217 @@
+"""Trainer: epoch loop, distributed eval, metering, CSV logs (C14/C15/C17/C21).
+
+Orchestrates the reference's train()/validate()/checkpoint skeleton (reference
+2.distributed.py:166-189) around the fused TPU step functions. Differences by
+design:
+
+* metric tensors are NOT pulled to host every batch (the reference's
+  per-batch barrier+allreduce serialized the step — SURVEY.md §3.2 note);
+  device metrics are fetched only at print-frequency boundaries, so the TPU
+  queue stays full (JAX async dispatch);
+* printing/logging is process-0-only (the reference printed on every rank —
+  duplicated output, 2.distributed.py:238-239);
+* per-epoch CSV timing matches reference format [wall_start, seconds]
+  (reference 1.dataparallel.py:187-190).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from tpu_dist import configs
+from tpu_dist.data import (DataLoader, DistributedSampler, load_dataset,
+                           make_transform, prefetch_to_device)
+from tpu_dist.engine import checkpoint as ckpt
+from tpu_dist.engine.state import TrainState, init_model
+from tpu_dist.engine.steps import make_eval_step, make_shard_map_train_step, make_train_step
+from tpu_dist.models import create_model
+from tpu_dist.ops import LossScaleState, make_optimizer, make_policy, step_decay_schedule
+from tpu_dist.parallel.mesh import batch_sharding, make_mesh, replicated
+from tpu_dist.utils.meters import AverageMeter, ProgressMeter
+
+
+class Trainer:
+    """One engine for all cookbook variants; flavor picked by config.
+
+    ``cfg.variant``: 'jit' (compiler-partitioned, DDP-equiv) or 'shard_map'
+    (explicit psum, horovod-equiv). Multi-host vs single-host is decided by
+    how the process was launched (tpu_dist.parallel.launch), not here.
+    """
+
+    def __init__(self, cfg: configs.TrainConfig, mesh=None):
+        self.cfg = cfg
+        if cfg.resume and not os.path.exists(cfg.resume):
+            # fail fast, before device/model setup
+            raise FileNotFoundError(f"--resume checkpoint not found: {cfg.resume}")
+        self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh_shape, cfg.mesh_axes)
+        self.policy = make_policy(cfg.precision)
+        self.train_ds, self.val_ds = load_dataset(
+            cfg.dataset, cfg.data, cfg.synth_train_size, cfg.synth_val_size,
+            seed=cfg.seed if cfg.seed is not None else 1234)
+        self.num_classes = self.train_ds.num_classes
+
+        nprocs = jax.process_count()
+        # global batch divided per process (reference 2.distributed.py:113);
+        # then further split per device by the mesh sharding.
+        if cfg.batch_size % (nprocs * max(1, self.mesh.devices.size // nprocs)) \
+                and cfg.batch_size % self.mesh.devices.size:
+            raise ValueError(
+                f"global batch {cfg.batch_size} not divisible by device count "
+                f"{self.mesh.devices.size}")
+        self.local_batch = cfg.batch_size // nprocs
+
+        self.model = create_model(
+            cfg.arch, num_classes=self.num_classes,
+            dtype=self.policy.compute_dtype, pretrained=cfg.pretrained)
+
+        seed = cfg.seed if cfg.seed is not None else 0
+        self.rng = jax.random.PRNGKey(seed)
+        h, w, c = self.train_ds.image_shape
+        params, batch_stats = init_model(
+            self.model, self.rng, (2, h, w, c))
+        params = self.policy.cast_params_for_storage(params)
+
+        # ceil: the sampler pads to full batches, so an epoch really runs
+        # ceil(N/batch) optimizer steps — floor would fire LR decay early
+        self.steps_per_epoch = max(1, -(-len(self.train_ds) // cfg.batch_size))
+        self.schedule = step_decay_schedule(
+            cfg.scaled_lr(jax.device_count() if cfg.lr_scale_by_world else 1),
+            self.steps_per_epoch, cfg.lr_step_epochs)
+        self.tx = make_optimizer(
+            cfg.lr, cfg.momentum, cfg.weight_decay, self.steps_per_epoch,
+            cfg.lr_step_epochs, schedule=self.schedule)
+        loss_scale = (LossScaleState.create(cfg.loss_scale)
+                      if cfg.loss_scale else None)
+        state = TrainState.create(params, batch_stats, self.tx, loss_scale)
+        # replicate state across the mesh explicitly
+        self.state = jax.device_put(state, replicated(self.mesh))
+
+        augment = self.train_ds.name.startswith(("imagenet", "synth-imagenet"))
+        self.transform = make_transform(
+            self.train_ds.mean, self.train_ds.std, augment=augment,
+            dtype=self.policy.compute_dtype)
+        eval_transform = make_transform(
+            self.val_ds.mean, self.val_ds.std, augment=False,
+            dtype=self.policy.compute_dtype)
+
+        if cfg.variant == "shard_map":
+            self.train_step = make_shard_map_train_step(
+                self.model, self.tx, self.transform, self.mesh,
+                grad_compression=cfg.grad_compression,
+                predivide_factor=cfg.gradient_predivide_factor)
+        else:
+            self.train_step = make_train_step(
+                self.model, self.tx, self.transform, self.mesh)
+        self.eval_step = make_eval_step(self.model, eval_transform, self.mesh)
+
+        self.batch_sharding = batch_sharding(self.mesh)
+        self.best_acc1 = 0.0
+        self.start_epoch = cfg.start_epoch
+        self.is_main = jax.process_index() == 0
+
+        if cfg.resume:
+            self.state, meta = ckpt.load_checkpoint(cfg.resume, state)
+            self.state = jax.device_put(self.state, replicated(self.mesh))
+            self.start_epoch = meta.get("epoch", 0)
+            self.best_acc1 = meta.get("best_acc1", 0.0)
+            self.log(f"=> resumed from {cfg.resume} (epoch {self.start_epoch})")
+
+    # ------------------------------------------------------------------
+    def log(self, *a, **k):
+        if self.is_main:
+            print(*a, **k, flush=True)
+
+    def _loader(self, ds, train: bool, epoch: int) -> DataLoader:
+        nprocs = jax.process_count()
+        sampler = DistributedSampler(
+            len(ds), num_replicas=nprocs, rank=jax.process_index(),
+            shuffle=train, seed=(self.cfg.seed or 0) + (17 if not train else 0),
+            batch_size=self.local_batch)
+        sampler.set_epoch(epoch)
+        return DataLoader(ds, sampler, self.local_batch,
+                          workers=self.cfg.workers, emit_valid=not train)
+
+    # ------------------------------------------------------------------
+    def train_epoch(self, epoch: int) -> Dict[str, float]:
+        cfg = self.cfg
+        loader = self._loader(self.train_ds, True, epoch)
+        nb = len(loader)
+        batch_time = AverageMeter("Time", ":6.3f")
+        data_time = AverageMeter("Data", ":6.3f")
+        losses = AverageMeter("Loss", ":.4e")
+        top1 = AverageMeter("Acc@1", ":6.3f")
+        top5 = AverageMeter("Acc@5", ":6.3f")
+        progress = ProgressMeter(nb, [batch_time, data_time, losses, top1, top5],
+                                 prefix=f"Epoch: [{epoch}]")
+        pending = []
+        end = time.time()
+        it = prefetch_to_device(iter(loader), self.batch_sharding)
+        for i, (images, labels) in enumerate(it):
+            data_time.update(time.time() - end)
+            self.state, metrics = self.train_step(
+                self.state, images, labels, self.rng)
+            pending.append(metrics)
+            if i % cfg.print_freq == 0 or i == nb - 1:
+                for m in jax.device_get(pending):
+                    n = float(m["count"])
+                    losses.update(float(m["loss_sum"]) / n, int(n))
+                    top1.update(float(m["correct1"]) / n, int(n))
+                    top5.update(float(m["correct5"]) / n, int(n))
+                pending = []
+                batch_time.update(time.time() - end)
+                if self.is_main:
+                    progress.display(i)
+            end = time.time()
+        return {"loss": losses.avg, "top1": top1.avg, "top5": top5.avg}
+
+    def validate(self, epoch: int = 0) -> float:
+        """Distributed eval (C15): metric sums psum'd across replicas, padding
+        masked out, exact division by the true sample count. device_get
+        happens ONCE after the loop so eval batches pipeline (async dispatch),
+        unlike the reference's per-batch barrier+allreduce."""
+        loader = self._loader(self.val_ds, False, epoch)
+        pending = []
+        it = prefetch_to_device(iter(loader), self.batch_sharding)
+        for images, labels, valid in it:
+            pending.append(self.eval_step(
+                self.state.params, self.state.batch_stats, images, labels, valid))
+        sums = {"loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0, "count": 0.0}
+        for m in jax.device_get(pending):
+            for k in sums:
+                sums[k] += float(m[k])
+        n = max(sums["count"], 1.0)
+        acc1 = sums["correct1"] / n
+        acc5 = sums["correct5"] / n
+        self.log(f" * Acc@1 {acc1 * 100:.3f} Acc@5 {acc5 * 100:.3f} "
+                 f"Loss {sums['loss_sum'] / n:.4f}")
+        return acc1
+
+    # ------------------------------------------------------------------
+    def fit(self) -> float:
+        cfg = self.cfg
+        if cfg.evaluate:
+            return self.validate()
+        csv_path = cfg.log_csv or ""
+        for epoch in range(self.start_epoch, cfg.epochs):
+            t0 = time.time()
+            train_metrics = self.train_epoch(epoch)
+            acc1 = self.validate(epoch)
+            epoch_secs = time.time() - t0
+            is_best = acc1 > self.best_acc1
+            self.best_acc1 = max(acc1, self.best_acc1)
+            if csv_path and self.is_main:
+                # reference CSV format: [wall start, epoch seconds]
+                with open(csv_path, "a+", newline="") as f:
+                    csv.writer(f).writerow([t0, epoch_secs])
+            ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, epoch + 1,
+                                 self.best_acc1, cfg.arch, is_best)
+            self.log(f"Epoch {epoch}: train_loss={train_metrics['loss']:.4f} "
+                     f"val_acc1={acc1 * 100:.3f} best={self.best_acc1 * 100:.3f} "
+                     f"({epoch_secs:.1f}s)")
+        return self.best_acc1

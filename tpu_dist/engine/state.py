@@ -1,0 +1,41 @@
+"""Train state pytree.
+
+The reference's mutable triple (model, optimizer, amp state) spread across
+wrapper objects (reference 2.distributed.py:114-120, 4.apex_distributed2.py:
+177-178) becomes one immutable pytree threaded through the jitted step —
+the functional JAX idiom. ``batch_stats`` carries BatchNorm running stats
+(torch buffers); ``loss_scale`` is the optional apex-style dynamic scale.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_dist.ops.precision import LossScaleState
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    loss_scale: Optional[LossScaleState] = None
+
+    @classmethod
+    def create(cls, params, batch_stats, tx: optax.GradientTransformation,
+               loss_scale: Optional[LossScaleState] = None) -> "TrainState":
+        return cls(step=jnp.int32(0), params=params, batch_stats=batch_stats,
+                   opt_state=tx.init(params), loss_scale=loss_scale)
+
+
+def init_model(model, rng: jax.Array, input_shape, train: bool = True):
+    """Initialize params/batch_stats with a dummy batch (static shapes)."""
+    dummy = jnp.zeros(input_shape, jnp.float32)
+    variables = model.init({"params": rng, "dropout": rng}, dummy, train=False)
+    return variables.get("params"), variables.get("batch_stats", {})

@@ -1,0 +1,4 @@
+from tpu_dist.models.lenet import LeNet  # noqa: F401
+from tpu_dist.models.registry import create_model, model_names, register  # noqa: F401
+from tpu_dist.models.resnet import (  # noqa: F401
+    ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152)

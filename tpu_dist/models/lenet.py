@@ -1,0 +1,41 @@
+"""MNIST conv net (reference component C3).
+
+Capability-equivalent of the reference's 17-line ``Net``
+(reference 5.2.horovod_pytorch_mnist.py:36-52): conv(10,5x5) -> maxpool -> relu
+-> conv(20,5x5) -> dropout2d -> maxpool -> relu -> fc(50) -> dropout -> fc(10)
+-> log_softmax.
+
+TPU notes: NHWC layout (XLA:TPU's native conv layout), flax.linen module,
+dropout driven by an explicit PRNG key (functional — no global RNG state).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet(nn.Module):
+    """MNIST classifier; input (B, 28, 28, 1) NHWC."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(10, (5, 5), padding="VALID", dtype=self.dtype, name="conv1")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype, name="conv2")(x)
+        x = nn.Dropout(0.5, deterministic=not train, name="conv2_drop")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))  # (B, 320)
+        x = nn.Dense(50, dtype=self.dtype, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train, name="drop")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc2")(x)
+        # reference returns log_softmax + NLL loss; we return logits and fold
+        # log_softmax into the loss (numerically identical, XLA fuses it).
+        return x.astype(jnp.float32)

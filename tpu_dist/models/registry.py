@@ -1,0 +1,47 @@
+"""Model factory (reference component C2).
+
+The reference selects any lowercase callable from
+``torchvision.models.__dict__`` by name (reference 1.dataparallel.py:23-24,
+97-102). tpu_dist keeps the same UX — ``create_model("resnet50")`` — over an
+explicit registry (no torchvision on TPU; ``--pretrained`` is accepted for CLI
+parity but there are no bundled weights in a zero-egress environment, so it
+raises a clear error instead of silently ignoring the flag).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+
+from tpu_dist.models import lenet, resnet
+
+_REGISTRY: Dict[str, Callable] = {
+    "resnet18": resnet.ResNet18,
+    "resnet34": resnet.ResNet34,
+    "resnet50": resnet.ResNet50,
+    "resnet101": resnet.ResNet101,
+    "resnet152": resnet.ResNet152,
+    "lenet": lenet.LeNet,
+    "mnist_net": lenet.LeNet,  # reference 5.2 'Net' alias
+}
+
+model_names = sorted(_REGISTRY)  # reference 1.dataparallel.py:23-24 equivalent
+
+
+def register(name: str):
+    def deco(ctor: Callable):
+        _REGISTRY[name] = ctor
+        return ctor
+    return deco
+
+
+def create_model(arch: str, num_classes: int = 10, dtype=jnp.float32,
+                 pretrained: bool = False, **kwargs):
+    if pretrained:
+        raise ValueError(
+            "--pretrained requires downloaded weights; this environment has no "
+            "egress. Train from scratch or point --resume at a checkpoint.")
+    if arch not in _REGISTRY:
+        raise ValueError(f"unknown arch {arch!r}; choose from {model_names}")
+    return _REGISTRY[arch](num_classes=num_classes, dtype=dtype, **kwargs)

@@ -1,0 +1,128 @@
+"""ResNet family (reference component C2's main archs).
+
+The reference pulls ResNets from ``torchvision.models.__dict__[arch]()``
+(reference 1.dataparallel.py:23-24,97-102) and trains them on CIFAR10 (32x32
+through the ImageNet stem) and ImageNet. This module provides the same family
+— resnet18/34/50/101/152 with torchvision's layer plan — built TPU-first:
+
+* NHWC layout (XLA:TPU native), channels padded to MXU-friendly multiples by
+  XLA automatically;
+* flax.linen with an fp32-master / configurable compute dtype split: conv and
+  dense run in ``dtype`` (bf16 for the apex-AMP-equivalent variant), batch-norm
+  statistics always accumulate in fp32 (SURVEY.md §7 'bf16 vs apex fp16');
+* under ``jit`` over a data-sharded mesh, batch-norm batch statistics are
+  computed over the *global* batch (XLA inserts the cross-device reduction),
+  which is SyncBN semantics — strictly stronger than the reference's
+  per-replica BN (documented capability delta);
+* an optional CIFAR stem (3x3/s1, no maxpool) for the TPU-native CIFAR recipe;
+  default stem matches torchvision (7x7/s2 + 3x3 maxpool) for parity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """torchvision BasicBlock: 3x3 -> 3x3 with identity shortcut (expansion 1)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    expansion: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides, padding=[(1, 1), (1, 1)])(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)])(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN gamma
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * self.expansion, (1, 1),
+                                 self.strides, name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    """torchvision Bottleneck: 1x1 -> 3x3 -> 1x1 (expansion 4)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    expansion: int = 4
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides, padding=[(1, 1), (1, 1)])(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * self.expansion, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * self.expansion, (1, 1),
+                                 self.strides, name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """torchvision-plan ResNet, NHWC, fp32 BN statistics.
+
+    stage_sizes/block follow torchvision exactly (e.g. resnet50 = Bottleneck
+    [3,4,6,3]); `cifar_stem=True` swaps the 7x7/s2+maxpool stem for 3x3/s1.
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+    cifar_stem: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       epsilon=1e-5, dtype=jnp.float32)  # stats & affine in fp32
+
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(64, (3, 3), padding=[(1, 1), (1, 1)], name="conv1")(x)
+            x = norm(name="bn1")(x)
+            x = nn.relu(x)
+        else:
+            x = conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv1")(x)
+            x = norm(name="bn1")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(filters=64 * 2 ** i, strides=strides,
+                                   conv=conv, norm=norm,
+                                   name=f"layer{i + 1}_block{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+        return x.astype(jnp.float32)
+
+
+# torchvision layer plans (reference models.__dict__ factory surface)
+ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=Bottleneck)
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3], block_cls=Bottleneck)
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3], block_cls=Bottleneck)

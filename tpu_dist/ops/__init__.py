@@ -1,0 +1,3 @@
+from tpu_dist.ops.optim import make_optimizer, step_decay_schedule  # noqa: F401
+from tpu_dist.ops.precision import (  # noqa: F401
+    LossScaleState, Policy, make_policy, scale_loss, unscale_and_update)

@@ -1,0 +1,54 @@
+"""Optimizer + LR schedule (reference components C19 and the SGD setup).
+
+Reference recipe: SGD momentum 0.9, weight decay 1e-4, lr 0.1 stepped x0.1
+every 30 epochs by mutating param_groups (reference 1.dataparallel.py:114-116,
+332-336); horovod scales base lr by world size (reference
+5.2.horovod_pytorch_mnist.py:159-171) and supports a gradient predivide factor
+(reference 5.2...py:185).
+
+TPU-first: the schedule is a pure function of the step counter evaluated
+*inside* the jitted update (no host mutation of optimizer state), built on
+optax. Weight decay matches torch SGD semantics exactly: wd*param is added to
+the gradient *before* momentum (optax.add_decayed_weights ordering).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import optax
+
+
+def step_decay_schedule(base_lr: float, steps_per_epoch: int,
+                        step_epochs: int = 30, factor: float = 0.1
+                        ) -> Callable:
+    """lr = base * factor^(epoch // step_epochs)  (reference 1.dataparallel.py:332-336)."""
+    def schedule(step):
+        epoch = step // max(steps_per_epoch, 1)
+        return base_lr * factor ** (epoch // step_epochs)
+    return schedule
+
+
+def make_optimizer(lr: float, momentum: float = 0.9, weight_decay: float = 1e-4,
+                   steps_per_epoch: int = 1, lr_step_epochs: int = 30,
+                   schedule: Optional[Callable] = None
+                   ) -> optax.GradientTransformation:
+    """torch.optim.SGD(momentum, weight_decay)-equivalent with step-decay LR.
+
+    Horovod's gradient_predivide_factor lives in the explicit-psum step
+    (tpu_dist.engine.steps.make_shard_map_train_step), matching horovod's
+    placement around the allreduce — NOT here, so it cannot double-apply.
+    """
+    sched = schedule or step_decay_schedule(lr, steps_per_epoch, lr_step_epochs)
+    chain = []
+    if weight_decay:
+        chain.append(optax.add_decayed_weights(weight_decay))
+    # torch SGD momentum: buf = mu*buf + grad; update = -lr*buf
+    chain.append(optax.trace(decay=momentum, nesterov=False))
+    chain.append(optax.scale_by_learning_rate(sched))
+    return optax.chain(*chain)
+
+
+def current_lr(schedule: Callable, step) -> jnp.ndarray:
+    return jnp.asarray(schedule(step))

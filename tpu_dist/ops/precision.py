@@ -1,0 +1,97 @@
+"""Mixed-precision policy layer (reference components C11/C12).
+
+The reference's precision stack is apex AMP: ``amp.initialize(model,
+optimizer)`` + dynamic loss scaling around backward
+(reference 4.apex_distributed2.py:177,289-290) and horovod's fp16-compressed
+gradient allreduce (reference 5.horovod_distributed.py:123-125).
+
+TPU-first mapping (SURVEY.md §2b apex row):
+
+* **bf16 compute** is the native TPU mixed precision — same exponent range as
+  fp32, so *no loss scaling is required*. ``Policy("bf16")`` runs matmuls/convs
+  in bf16 on the MXU with fp32 master params and fp32 batch-norm statistics
+  (the apex O1-ish default).
+* ``Policy("bf16_params")`` additionally keeps params in bf16 (apex O2-ish;
+  halves HBM traffic for weights).
+* Optional **dynamic loss scaling** is provided anyway for semantic parity
+  with apex's fp16 path (and for numerics experiments): scale up the loss,
+  unscale grads, skip the step and halve the scale on non-finite grads, double
+  every ``growth_interval`` good steps — the apex algorithm, as a pure pytree
+  so it lives inside the jitted step (no Python control flow).
+* fp16-compressed allreduce maps to bf16 grad compression in
+  tpu_dist.parallel.collectives.compress_grads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class Policy:
+    """Dtype policy: where params live, where compute happens."""
+
+    name: str = "fp32"
+
+    @property
+    def compute_dtype(self):
+        return jnp.bfloat16 if self.name in ("bf16", "bf16_params") else jnp.float32
+
+    @property
+    def param_dtype(self):
+        return jnp.bfloat16 if self.name == "bf16_params" else jnp.float32
+
+    def cast_params_for_storage(self, params):
+        return jax.tree.map(
+            lambda p: p.astype(self.param_dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+
+
+def make_policy(name: str) -> Policy:
+    if name not in ("fp32", "bf16", "bf16_params"):
+        raise ValueError(f"unknown precision {name!r} (fp32|bf16|bf16_params)")
+    return Policy(name)
+
+
+class LossScaleState(NamedTuple):
+    """Dynamic loss-scale state (apex amp.scale_loss equivalent)."""
+
+    scale: jax.Array          # current multiplicative scale
+    good_steps: jax.Array     # consecutive finite-grad steps
+
+    @staticmethod
+    def create(initial: float = 2.0 ** 15):
+        return LossScaleState(jnp.float32(initial), jnp.int32(0))
+
+
+def scale_loss(loss: jax.Array, state: LossScaleState | None) -> jax.Array:
+    return loss if state is None else loss * state.scale
+
+
+def unscale_and_update(grads: Any, state: LossScaleState | None,
+                       growth_interval: int = 2000,
+                       ) -> Tuple[Any, LossScaleState | None, jax.Array]:
+    """Unscale grads; decide whether the step is safe (all-finite).
+
+    Returns (unscaled_grads, new_state, grads_finite). With ``state=None``
+    (bf16/fp32 path) grads pass through and grads_finite is True — the step is
+    unconditional, exactly like the reference's non-apex variants.
+    """
+    if state is None:
+        return grads, None, jnp.bool_(True)
+    inv = 1.0 / state.scale
+    grads = jax.tree.map(lambda g: g * inv, grads)
+    finite = jnp.all(jnp.stack([jnp.all(jnp.isfinite(g))
+                                for g in jax.tree.leaves(grads)]))
+    new_good = jnp.where(finite, state.good_steps + 1, 0)
+    grow = new_good >= growth_interval
+    new_scale = jnp.where(
+        finite,
+        jnp.where(grow, state.scale * 2.0, state.scale),
+        jnp.maximum(state.scale * 0.5, 1.0))
+    new_good = jnp.where(grow, 0, new_good)
+    return grads, LossScaleState(new_scale, new_good), finite

@@ -1,0 +1,6 @@
+from tpu_dist.parallel.mesh import (  # noqa: F401
+    DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS,
+    batch_sharding, make_mesh, replicated, world_info)
+from tpu_dist.parallel.collectives import (  # noqa: F401
+    allreduce_bench, barrier, compress_grads, pmean, psum, reduce_mean)
+from tpu_dist.parallel import launch  # noqa: F401

@@ -1,0 +1,128 @@
+"""Collective ops + metric reduction + allreduce microbenchmark.
+
+Replaces, TPU-first, the reference's three collective mechanisms
+(SURVEY.md §5 'Distributed communication backend'):
+
+* NCCL ``all_reduce(SUM)/nprocs`` metric averaging with a ``dist.barrier()``
+  before it (reference 2.distributed.py:71-75,219-223) -> :func:`reduce_mean`
+  (inside shard_map) or simply computing on globally-sharded arrays under jit
+  (XLA inserts the reduction);
+* horovod ``hvd.allreduce`` which averages natively — the upstream
+  double-average bug fix (reference 5.horovod_distributed.py:70-75,
+  README_EN.md:7) is moot here: there is exactly one averaging point;
+* ``dist.barrier()`` -> :func:`barrier`, a blocking 1-element psum across the
+  mesh (a barrier on TPU *is* a tiny collective).
+
+Also provides the bf16 gradient-compression hook (hvd.Compression.fp16-equiv,
+reference 5.horovod_distributed.py:123-125) and the allreduce-latency
+microbenchmark that BASELINE.md requires this repo to establish.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_dist.parallel.mesh import DATA_AXIS
+
+
+# ---- in-step collectives (used under shard_map with an axis name) ----------
+
+def psum(x, axis_name: str = DATA_AXIS):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str = DATA_AXIS):
+    return jax.lax.pmean(x, axis_name)
+
+
+def reduce_mean(tree, axis_name: str = DATA_AXIS):
+    """C16 equivalent: average a metric pytree across replicas.
+
+    Reference pattern: clone -> all_reduce(SUM) -> /nprocs
+    (2.distributed.py:71-75). Here a single fused pmean; no barrier is needed
+    (XLA orders collectives), removing the reference's per-batch
+    barrier+allreduce serialization bug (SURVEY.md §3.2 note).
+    """
+    return jax.tree.map(lambda t: jax.lax.pmean(t, axis_name), tree)
+
+
+def compress_grads(tree, compression: str = "none"):
+    """Gradient payload compression before cross-replica reduction.
+
+    'bf16' mirrors hvd.Compression.fp16 (reference 5.horovod_distributed.py:
+    123-125): cast to bf16, reduce, cast back — halves ICI bytes.
+    """
+    if compression == "none":
+        return tree, lambda t: t
+    if compression == "bf16":
+        orig_dtypes = jax.tree.map(lambda t: t.dtype, tree)
+        down = jax.tree.map(lambda t: t.astype(jnp.bfloat16), tree)
+        up = lambda t: jax.tree.map(lambda x, d: x.astype(d), t, orig_dtypes)
+        return down, up
+    raise ValueError(f"unknown grad compression {compression!r}")
+
+
+# ---- host-level barrier ----------------------------------------------------
+
+def barrier(mesh: Mesh | None = None) -> None:
+    """Block until all devices (all hosts' chips) reach this point.
+
+    dist.barrier() equivalent (reference 2.distributed.py:219): a 1-element
+    psum across every device, then block on the result.
+    """
+    devices = list(mesh.devices.flat) if mesh is not None else jax.devices()
+    m = Mesh(np.asarray(devices), ("all",))
+    one = jax.device_put(
+        jnp.zeros((len(devices),), jnp.int32),
+        NamedSharding(m, P("all")))
+    jnp.sum(one).block_until_ready()
+
+
+# ---- allreduce microbenchmark (BASELINE.md 'allreduce µs') -----------------
+
+def allreduce_bench(mesh: Mesh | None = None,
+                    sizes_mb: Sequence[float] = (0.004, 1.0, 16.0, 64.0),
+                    dtype=jnp.float32, iters: int = 20) -> dict:
+    """Measure cross-device allreduce latency/bandwidth on this mesh.
+
+    Returns {size_mb: {"us": mean_latency_us, "gbps": algo_bandwidth}}.
+    The reference's analog capability lives inside NCCL; on TPU we measure the
+    XLA collective end-to-end (jit'd psum of a device-sharded buffer).
+    """
+    if mesh is None:
+        from tpu_dist.parallel.mesh import make_mesh
+        mesh = make_mesh()
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+    results = {}
+    for mb in sizes_mb:
+        elems_per_dev = max(1, int(mb * 1e6 / jnp.dtype(dtype).itemsize))
+        x = jax.device_put(
+            jnp.ones((n, elems_per_dev), dtype),
+            NamedSharding(mesh, P(axis)))
+
+        @partial(jax.jit,
+                 in_shardings=NamedSharding(mesh, P(axis)),
+                 out_shardings=NamedSharding(mesh, P(axis)))
+        def allreduce(v):
+            # sum over the sharded axis then broadcast back = allreduce; XLA
+            # lowers this to a native all-reduce over ICI.
+            return jnp.broadcast_to(jnp.sum(v, axis=0, keepdims=True), v.shape)
+
+        allreduce(x).block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = allreduce(x)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        nbytes = elems_per_dev * jnp.dtype(dtype).itemsize
+        results[mb] = {"us": dt * 1e6,
+                       "gbps": (2 * (n - 1) / max(n, 1)) * nbytes / dt / 1e9}
+    return results

@@ -1,0 +1,99 @@
+"""Process launch / rendezvous layer (reference C23/C25 + the four rendezvous
+flavors of SURVEY.md §5).
+
+The reference rendezvouses four ways — env:// from torch.distributed.launch
+(2.distributed.py:98), tcp:// (3.multiprocessing_distributed.py:102), file://
+on a shared FS keyed by SLURM_JOBID (6.distributed_slurm_main.py:93-101), and
+an MPI/Gloo controller under horovodrun (5.run.sh:3). On TPU these collapse to
+one thing: coordinator-address discovery for ``jax.distributed.initialize``
+over DCN. This module abstracts that discovery, in priority order:
+
+1. explicit args / tpu_dist env (TPU_DIST_COORDINATOR, TPU_DIST_NUM_PROCESSES,
+   TPU_DIST_PROCESS_ID)  — env:// equivalent;
+2. Slurm env (SLURM_PROCID/SLURM_NPROCS/SLURM_JOB_NODELIST) — variant-6
+   equivalent, same rank math;
+3. TPU pod metadata — ``jax.distributed.initialize()`` with no args
+   autodetects on Cloud TPU;
+4. nothing set -> single-process (variants 1-style local run).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class LaunchInfo:
+    coordinator: Optional[str]
+    num_processes: int
+    process_id: int
+    method: str  # env | slurm | tpu-metadata | local
+
+
+def _slurm_first_host(nodelist: str) -> str:
+    """Expand 'prefix[a-b,c],other' to its first hostname (no external tools)."""
+    m = re.match(r"([^\[,]+)(\[([^\]]+)\])?", nodelist)
+    if not m:
+        return nodelist.split(",")[0]
+    prefix, _, body = m.groups()
+    if not body:
+        return prefix
+    first = body.split(",")[0].split("-")[0]
+    return prefix + first
+
+
+def detect_launch(coordinator: Optional[str] = None,
+                  num_processes: Optional[int] = None,
+                  process_id: Optional[int] = None,
+                  port: int = 8476) -> LaunchInfo:
+    env = os.environ
+    if coordinator or env.get("TPU_DIST_COORDINATOR"):
+        return LaunchInfo(
+            coordinator or env["TPU_DIST_COORDINATOR"],
+            int(num_processes if num_processes is not None
+                else env.get("TPU_DIST_NUM_PROCESSES", "1")),
+            int(process_id if process_id is not None
+                else env.get("TPU_DIST_PROCESS_ID", "0")),
+            "env")
+    if "SLURM_PROCID" in env and env.get("SLURM_NPROCS", "1") != "1":
+        # reference 6.distributed_slurm_main.py:89-94: rank from SLURM_PROCID,
+        # world from SLURM_NPROCS; file:// rendezvous becomes coordinator TCP.
+        host = _slurm_first_host(env.get("SLURM_JOB_NODELIST", "localhost"))
+        return LaunchInfo(f"{host}:{port}", int(env["SLURM_NPROCS"]),
+                          int(env["SLURM_PROCID"]), "slurm")
+    workers = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    if len(workers) > 1 or env.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        return LaunchInfo(None, -1, -1, "tpu-metadata")
+    return LaunchInfo(None, 1, 0, "local")
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> LaunchInfo:
+    """Multi-host init (idempotent). The hvd.init()/init_process_group analog."""
+    import jax
+    # Pin the platform choice via jax.config BEFORE distributed init: on images
+    # whose sitecustomize pre-registers a TPU plugin, the env var alone leaves
+    # jax.distributed binding to the wrong backend (observed: process_count
+    # stays 1 despite a successful coordination-service rendezvous).
+    platform = os.environ.get("TPU_DIST_PLATFORM") or os.environ.get("JAX_PLATFORMS")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    info = detect_launch(coordinator, num_processes, process_id)
+    if info.method == "local":
+        return info
+    if info.method == "tpu-metadata":
+        try:
+            jax.distributed.initialize()
+        except ValueError:
+            # metadata incomplete (e.g. single-host dev box) -> local run
+            return LaunchInfo(None, 1, 0, "local")
+        return LaunchInfo(None, jax.process_count(), jax.process_index(),
+                          "tpu-metadata")
+    jax.distributed.initialize(coordinator_address=info.coordinator,
+                               num_processes=info.num_processes,
+                               process_id=info.process_id)
+    return info

@@ -1,0 +1,69 @@
+"""Device mesh construction + sharding helpers (the TPU 'backend' layer).
+
+The reference's backend selection (`dist.init_process_group(backend='nccl')`,
+reference 2.distributed.py:98) has no TPU analog — the XLA runtime over
+ICI/DCN *is* the backend (SURVEY.md §2b NCCL row). What the framework owns is
+the mesh: axis layout, shardings, and the collectives that ride it.
+
+Axis conventions (scaling-book style):
+* ``data``  — batch/data parallel (the only axis the reference exercises);
+* ``fsdp``  — parameter-sharded data parallel (extension axis);
+* ``model`` — tensor parallel (extension axis);
+* ``seq``   — sequence/context parallel for long-context models.
+
+All tpu_dist engines take a Mesh; single-host multi-device (reference variant
+1), multi-host DDP (variants 2/3/6), and horovod-style (variant 5) differ only
+in how many processes contribute devices to that mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              axes: Sequence[str] = (DATA_AXIS,),
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh. Default: 1-D data-parallel over all addressable devices.
+
+    ``shape=(dp, tp)`` with ``axes=("data", "model")`` etc. A -1 entry is
+    inferred from the device count (like a reshape).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = (n,)
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = n // max(known, 1)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {tuple(shape)} != {n} devices")
+    if len(shape) != len(axes):
+        raise ValueError(f"shape {tuple(shape)} rank != axes {tuple(axes)}")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axes))
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard dim 0 (batch) across the data axis; replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def world_info() -> Tuple[int, int, int, int]:
+    """(process_index, process_count, local_devices, global_devices)."""
+    return (jax.process_index(), jax.process_count(),
+            jax.local_device_count(), jax.device_count())

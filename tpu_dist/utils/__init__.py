@@ -1,0 +1,1 @@
+from tpu_dist.utils.meters import AverageMeter, ProgressMeter, accuracy, topk_accuracy  # noqa: F401

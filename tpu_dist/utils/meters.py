@@ -1,0 +1,108 @@
+"""Meters + accuracy (reference components C17/C18).
+
+The reference copies ``AverageMeter``/``ProgressMeter`` verbatim into every
+script (reference: 1.dataparallel.py:291-329 and five clones). Accuracy exists
+in two reference flavors:
+
+* a simplified top-1 (argmax == target fraction) returned twice as "top1/top5"
+  (reference 1.dataparallel.py:339-364, documented in README_EN.md:654) — kept
+  here as :func:`accuracy` for numeric parity with the cookbook's printouts;
+* the real top-k percent version used by the Slurm variant
+  (reference 6.distributed_slurm_main.py:335-349) — kept as
+  :func:`topk_accuracy` and used by default in tpu_dist because it is correct.
+
+On TPU the accuracy math runs *inside* the jitted step on device (returning
+summed-correct counts so cross-replica reduction is an exact psum, not the
+reference's equal-weight average of per-rank fractions — see SURVEY.md §7
+"Metric parity"); these host-side helpers mirror the same math for tests and
+for eval-on-host paths.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class AverageMeter:
+    """Running value/avg/sum/count meter (reference 1.dataparallel.py:291-312)."""
+
+    def __init__(self, name: str, fmt: str = ":f"):
+        self.name = name
+        self.fmt = fmt
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val, n: int = 1):
+        val = float(val)
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+    def __str__(self):
+        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
+        return fmtstr.format(**self.__dict__)
+
+
+class ProgressMeter:
+    """Tab-joined progress line every N batches (reference 1.dataparallel.py:315-329)."""
+
+    def __init__(self, num_batches: int, meters, prefix: str = ""):
+        self.batch_fmtstr = self._get_batch_fmtstr(num_batches)
+        self.meters = meters
+        self.prefix = prefix
+
+    def display(self, batch: int, printer=print):
+        entries = [self.prefix + self.batch_fmtstr.format(batch)]
+        entries += [str(meter) for meter in self.meters]
+        printer("\t".join(entries))
+
+    @staticmethod
+    def _get_batch_fmtstr(num_batches: int) -> str:
+        num_digits = len(str(num_batches // 1))
+        fmt = "{:" + str(num_digits) + "d}"
+        return "[" + fmt + "/" + fmt.format(num_batches) + "]"
+
+
+def accuracy(output, target):
+    """Reference's simplified accuracy: argmax==target fraction, returned twice
+    as (top1, top5) for printout parity (reference 1.dataparallel.py:339-364)."""
+    pred = jnp.argmax(output, axis=-1)
+    acc = jnp.mean((pred == target).astype(jnp.float32))
+    return acc, acc
+
+
+def topk_accuracy(output, target, topk=(1, 5)):
+    """True top-k accuracy in percent (reference 6.distributed_slurm_main.py:335-349).
+
+    Static-shape friendly: uses top_k + any-match rather than sort+index tricks.
+    """
+    maxk = max(topk)
+    topk_idx = jnp.argsort(-output, axis=-1)[:, :maxk]
+    correct = topk_idx == target[:, None]
+    res = []
+    batch = target.shape[0]
+    for k in topk:
+        correct_k = jnp.sum(jnp.any(correct[:, :k], axis=-1).astype(jnp.float32))
+        res.append(correct_k * (100.0 / batch))
+    return res
+
+
+def correct_counts(output, target, topk=(1, 5)):
+    """Summed correct-prediction counts for exact distributed metric reduction.
+
+    Returning *counts* (not fractions) lets the engine psum them across replicas
+    and divide by the true global sample count — fixing the reference's
+    equal-weight averaging of unequal last batches (reference
+    2.distributed.py:221-227; SURVEY.md §7 'Metric parity').
+    """
+    maxk = max(topk)
+    topk_idx = jnp.argsort(-output, axis=-1)[:, :maxk]
+    correct = topk_idx == target[:, None]
+    return tuple(jnp.sum(jnp.any(correct[:, :k], axis=-1).astype(jnp.float32))
+                 for k in topk)
