@@ -158,3 +158,43 @@ def test_grad_compression_still_converges():
         first = loss if first is None else first
         last = loss
     assert last < first
+
+
+def test_multi_step_equals_sequential_steps():
+    """K steps in one scan dispatch == K sequential jit dispatches."""
+    from tpu_dist.engine.steps import make_multi_train_step
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh()
+    model = _MLP()
+    params, stats = init_model(model, jax.random.PRNGKey(0), (2, 28, 28, 1))
+    tx = make_optimizer(0.1, 0.9, 1e-4, steps_per_epoch=1000)
+    state0 = jax.device_put(TrainState.create(params, stats, tx),
+                            replicated(mesh))
+    transform = make_transform(np.full((1,), 0.5, np.float32),
+                               np.full((1,), 0.25, np.float32))
+    single = make_train_step(model, tx, transform, mesh, donate=False)
+    multi = make_multi_train_step(model, tx, transform, mesh, donate=False)
+
+    k, b = 3, 32
+    rng_np = np.random.default_rng(0)
+    imgs = rng_np.integers(0, 255, (k, b, 28, 28, 1)).astype(np.uint8)
+    lbls = rng_np.integers(0, 10, (k, b)).astype(np.int32)
+    key = jax.random.PRNGKey(7)
+
+    sh = batch_sharding(mesh)
+    s_seq = state0
+    total = 0.0
+    for i in range(k):
+        s_seq, m = single(s_seq, jax.device_put(imgs[i], sh),
+                          jax.device_put(lbls[i], sh), key)
+        total += float(jax.device_get(m["loss_sum"]))
+
+    sh2 = NamedSharding(mesh, P(None, "data"))
+    s_multi, m_multi = multi(state0, jax.device_put(imgs, sh2),
+                             jax.device_put(lbls, sh2), key)
+    assert float(jax.device_get(m_multi["loss_sum"])) == pytest.approx(total, rel=1e-5)
+    fa = np.concatenate([np.asarray(x).ravel() for x in jax.tree.leaves(s_seq.params)])
+    fb = np.concatenate([np.asarray(x).ravel() for x in jax.tree.leaves(s_multi.params)])
+    np.testing.assert_allclose(fa, fb, rtol=1e-5, atol=1e-7)
+    assert int(jax.device_get(s_multi.step)) == k

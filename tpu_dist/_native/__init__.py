@@ -1,0 +1,85 @@
+"""ctypes bindings for the native host-data-path library (csrc/).
+
+Auto-builds with the in-tree Makefile on first import if g++ is available;
+every entry point has a pure-numpy fallback, so the framework works without a
+toolchain (the native path just makes the 1-core host loader faster and lets
+batch assembly overlap compute by releasing the GIL during memcpy).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libtpudist.so")
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) and os.path.isdir(_CSRC):
+        # cross-process build lock: spawned ranks / multi-host shared FS must
+        # not run `make` concurrently onto the same .so (a reader could dlopen
+        # a half-written ELF and silently pin itself to the numpy fallback)
+        import fcntl
+        lock_path = os.path.join(_CSRC, ".build.lock")
+        try:
+            with open(lock_path, "w") as lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                if not os.path.exists(_LIB_PATH):  # re-check under the lock
+                    subprocess.run(["make", "-C", _CSRC], check=True,
+                                   capture_output=True, timeout=120)
+        except Exception:
+            return None
+    if os.path.exists(_LIB_PATH):
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.gather_rows_u8.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int64]
+            lib.gather_i32.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64]
+            _lib = lib
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def gather_batch(images: np.ndarray, labels: np.ndarray,
+                 indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """out = (images[indices], labels[indices]) via native memcpy rows.
+
+    Falls back to numpy fancy indexing when the library is unavailable.
+    """
+    lib = _load()
+    idx_arr = np.asarray(indices)
+    # the native path has no bounds checking (raw memcpy); route anything
+    # numpy-special (negative indices, out-of-range -> IndexError) to numpy
+    in_bounds = (idx_arr.size == 0 or
+                 (idx_arr.min() >= 0 and idx_arr.max() < images.shape[0]))
+    if lib is None or not images.flags.c_contiguous or not in_bounds:
+        return images[indices], labels[indices]
+    idx = np.ascontiguousarray(idx_arr, np.int64)
+    n = idx.shape[0]
+    row_bytes = images.dtype.itemsize * int(np.prod(images.shape[1:]))
+    out_imgs = np.empty((n,) + images.shape[1:], images.dtype)
+    lib.gather_rows_u8(images.ctypes.data, idx.ctypes.data,
+                       out_imgs.ctypes.data, n, row_bytes)
+    lab = np.ascontiguousarray(labels, np.int32)
+    out_lab = np.empty((n,), np.int32)
+    lib.gather_i32(lab.ctypes.data, idx.ctypes.data, out_lab.ctypes.data, n)
+    return out_imgs, out_lab

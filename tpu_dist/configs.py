@@ -42,6 +42,8 @@ class TrainConfig:
     weight_decay: float = 1e-4
     lr_step_epochs: int = 30           # x0.1 every N epochs (1.dataparallel.py:332-336)
     lr_scale_by_world: bool = False    # horovod-style lr x world_size (5.2...py:159-171)
+    optimizer: str = "sgd"             # sgd (optax) | fused_sgd (Pallas kernel,
+                                       # apex fused-optimizer analog)
 
     # -- loop control (reference 1.dataparallel.py:57-70)
     print_freq: int = 10

@@ -64,8 +64,12 @@ class ArrayDataset:
 
         The common protocol between in-memory arrays and lazy ImageFolder-style
         datasets (tpu_dist.data.imagefolder); the loader only ever calls this.
+        Uses the native row-gather library (csrc/gather.cpp) when built —
+        whole-row memcpy with the GIL released, so batch assembly overlaps the
+        device step; numpy fallback otherwise.
         """
-        return self.images[indices], self.labels[indices]
+        from tpu_dist import _native
+        return _native.gather_batch(self.images, self.labels, indices)
 
 
 def _synthetic(num: int, shape: Tuple[int, int, int], num_classes: int,

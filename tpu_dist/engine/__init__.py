@@ -2,4 +2,5 @@ from tpu_dist.engine.checkpoint import load_checkpoint, save_checkpoint  # noqa:
 from tpu_dist.engine.loop import Trainer  # noqa: F401
 from tpu_dist.engine.state import TrainState, init_model  # noqa: F401
 from tpu_dist.engine.steps import (  # noqa: F401
-    cross_entropy_sum, make_eval_step, make_shard_map_train_step, make_train_step)
+    cross_entropy_sum, make_eval_step, make_multi_train_step,
+    make_shard_map_train_step, make_train_step)

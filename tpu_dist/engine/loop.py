@@ -46,9 +46,13 @@ class Trainer:
 
     def __init__(self, cfg: configs.TrainConfig, mesh=None):
         self.cfg = cfg
+        # fail fast on bad config, before device/model setup
         if cfg.resume and not os.path.exists(cfg.resume):
-            # fail fast, before device/model setup
             raise FileNotFoundError(f"--resume checkpoint not found: {cfg.resume}")
+        if cfg.optimizer not in ("sgd", "fused_sgd"):
+            raise ValueError(f"unknown optimizer {cfg.optimizer!r} (sgd|fused_sgd)")
+        if cfg.variant not in ("jit", "shard_map"):
+            raise ValueError(f"unknown variant {cfg.variant!r} (jit|shard_map)")
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh_shape, cfg.mesh_axes)
         self.policy = make_policy(cfg.precision)
         self.train_ds, self.val_ds = load_dataset(
@@ -83,9 +87,14 @@ class Trainer:
         self.schedule = step_decay_schedule(
             cfg.scaled_lr(jax.device_count() if cfg.lr_scale_by_world else 1),
             self.steps_per_epoch, cfg.lr_step_epochs)
-        self.tx = make_optimizer(
-            cfg.lr, cfg.momentum, cfg.weight_decay, self.steps_per_epoch,
-            cfg.lr_step_epochs, schedule=self.schedule)
+        if cfg.optimizer == "fused_sgd":  # validated at __init__ entry
+            from tpu_dist.ops.pallas_sgd import FusedSGD
+            self.tx = FusedSGD(self.schedule, cfg.momentum, cfg.weight_decay,
+                               interpret=jax.default_backend() == "cpu")
+        else:
+            self.tx = make_optimizer(
+                cfg.lr, cfg.momentum, cfg.weight_decay, self.steps_per_epoch,
+                cfg.lr_step_epochs, schedule=self.schedule)
         loss_scale = (LossScaleState.create(cfg.loss_scale)
                       if cfg.loss_scale else None)
         state = TrainState.create(params, batch_stats, self.tx, loss_scale)
@@ -197,21 +206,35 @@ class Trainer:
         cfg = self.cfg
         if cfg.evaluate:
             return self.validate()
+        profiling = bool(cfg.profile_dir) and self.is_main
+        if profiling:
+            # device tracing (reference's only profiling was wall-clock CSVs +
+            # nvidia-smi sampling, statistics.sh:1-4; the TPU-native answer is
+            # a real XLA trace: per-op device time, HBM, MXU utilization)
+            import jax.profiler
+            jax.profiler.start_trace(cfg.profile_dir)
         csv_path = cfg.log_csv or ""
-        for epoch in range(self.start_epoch, cfg.epochs):
-            t0 = time.time()
-            train_metrics = self.train_epoch(epoch)
-            acc1 = self.validate(epoch)
-            epoch_secs = time.time() - t0
-            is_best = acc1 > self.best_acc1
-            self.best_acc1 = max(acc1, self.best_acc1)
-            if csv_path and self.is_main:
-                # reference CSV format: [wall start, epoch seconds]
-                with open(csv_path, "a+", newline="") as f:
-                    csv.writer(f).writerow([t0, epoch_secs])
-            ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, epoch + 1,
-                                 self.best_acc1, cfg.arch, is_best)
-            self.log(f"Epoch {epoch}: train_loss={train_metrics['loss']:.4f} "
-                     f"val_acc1={acc1 * 100:.3f} best={self.best_acc1 * 100:.3f} "
-                     f"({epoch_secs:.1f}s)")
+        try:
+            for epoch in range(self.start_epoch, cfg.epochs):
+                t0 = time.time()
+                train_metrics = self.train_epoch(epoch)
+                acc1 = self.validate(epoch)
+                epoch_secs = time.time() - t0
+                is_best = acc1 > self.best_acc1
+                self.best_acc1 = max(acc1, self.best_acc1)
+                if csv_path and self.is_main:
+                    # reference CSV format: [wall start, epoch seconds]
+                    with open(csv_path, "a+", newline="") as f:
+                        csv.writer(f).writerow([t0, epoch_secs])
+                ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, epoch + 1,
+                                     self.best_acc1, cfg.arch, is_best)
+                self.log(f"Epoch {epoch}: train_loss={train_metrics['loss']:.4f} "
+                         f"val_acc1={acc1 * 100:.3f} best={self.best_acc1 * 100:.3f} "
+                         f"({epoch_secs:.1f}s)")
+        finally:
+            if profiling:
+                # flush the trace even on OOM/interrupt — a failing run is
+                # exactly the one worth profiling
+                import jax.profiler
+                jax.profiler.stop_trace()
         return self.best_acc1

@@ -1,0 +1,121 @@
+"""Pallas fused SGD-momentum update kernel (apex fused-optimizer analog).
+
+The reference leaned on apex's fused CUDA optimizer kernels
+(reference 4.apex_distributed2.py:21-22,177; README_EN.md:292-326 documents
+the nvcc --cuda_ext build). TPU-native equivalent: one Pallas kernel applies
+weight decay + momentum + parameter update in a single pass over each leaf —
+read (p, g, m), write (p', m') — instead of the optax chain's conceptual
+multi-pass (XLA usually fuses that chain inside the jitted step too, so the
+honest value here is guaranteed fusion + a vehicle for lower-precision
+momentum experiments; the microbenchmark in tests reports both paths).
+
+Update rule, exactly torch.optim.SGD (reference 1.dataparallel.py:114-116):
+    g' = g + wd * p
+    m' = mu * m + g'
+    p' = p - lr * m'
+
+All math in fp32 regardless of the param dtype (bf16 params round once, at
+the final store) — matching fp32 master-weight semantics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128          # VPU lane width
+BLOCK_ROWS = 512    # rows per grid step: 512x128 fp32 = 256 KiB/buffer in VMEM
+
+
+def _sgd_kernel(scal_ref, p_ref, g_ref, m_ref, p_out, m_out):
+    lr = scal_ref[0, 0]
+    mu = scal_ref[0, 1]
+    wd = scal_ref[0, 2]
+    p = p_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32) + wd * p
+    m = mu * m_ref[:].astype(jnp.float32) + g
+    p_out[:] = (p - lr * m).astype(p_out.dtype)
+    m_out[:] = m
+
+
+def _fused_sgd_2d(p2, g2, m2, scalars, interpret: bool):
+    rows = p2.shape[0]
+    grid = (pl.cdiv(rows, BLOCK_ROWS),)
+    bs = lambda: pl.BlockSpec((BLOCK_ROWS, LANE), lambda i: (i, 0),
+                              memory_space=pltpu.ANY if interpret else pltpu.VMEM)
+    return pl.pallas_call(
+        _sgd_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, 4), lambda i: (0, 0),
+                               memory_space=pltpu.SMEM),
+                  bs(), bs(), bs()],
+        out_specs=[bs(), bs()],
+        out_shape=[jax.ShapeDtypeStruct(p2.shape, p2.dtype),
+                   jax.ShapeDtypeStruct(m2.shape, jnp.float32)],
+        input_output_aliases={1: 0, 3: 1},  # donate p and m buffers
+        interpret=interpret,
+    )(scalars, p2, g2, m2)
+
+
+def fused_sgd_leaf(p, g, m, lr, momentum, weight_decay, interpret=False):
+    """Apply the fused update to one array (any shape/dtype); returns (p', m')."""
+    shape, size = p.shape, p.size
+    rows = -(-size // LANE)
+    pad = rows * LANE - size
+    def to2d(x, dtype):
+        flat = x.astype(dtype).reshape(-1)
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        return flat.reshape(rows, LANE)
+    scalars = jnp.stack([jnp.asarray(lr, jnp.float32),
+                         jnp.asarray(momentum, jnp.float32),
+                         jnp.asarray(weight_decay, jnp.float32),
+                         jnp.float32(0)]).reshape(1, 4)
+    p2, m2 = _fused_sgd_2d(to2d(p, p.dtype), to2d(g, jnp.float32),
+                           to2d(m, jnp.float32), scalars, interpret)
+    unpad = lambda x2, dt: x2.reshape(-1)[:size].reshape(shape).astype(dt)
+    return unpad(p2, p.dtype), unpad(m2, jnp.float32)
+
+
+class FusedSGDState(NamedTuple):
+    trace: Any  # momentum buffers, fp32
+
+
+class FusedSGD:
+    """Fused-kernel optimizer with the engine-facing apply() protocol.
+
+    Unlike an optax GradientTransformation (which returns *updates* that the
+    caller adds — forcing an extra pass), apply() fuses the whole update and
+    returns new params directly. The engine step builders accept either.
+    """
+
+    def __init__(self, schedule: Callable, momentum: float = 0.9,
+                 weight_decay: float = 1e-4, interpret: bool = False):
+        self.schedule = schedule
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.interpret = interpret
+
+    def init(self, params) -> FusedSGDState:
+        return FusedSGDState(trace=jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params))
+
+    def apply(self, params, grads, state: FusedSGDState, step):
+        lr = jnp.asarray(self.schedule(step), jnp.float32)
+        out = jax.tree.map(
+            partial(self._leaf, lr), params, grads, state.trace)
+        new_params = jax.tree.map(lambda o: o[0], out,
+                                  is_leaf=lambda x: isinstance(x, tuple))
+        new_trace = jax.tree.map(lambda o: o[1], out,
+                                 is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, FusedSGDState(trace=new_trace)
+
+    def _leaf(self, lr, p, g, m):
+        return fused_sgd_leaf(p, g, m, lr, self.momentum, self.weight_decay,
+                              interpret=self.interpret)
