@@ -1,0 +1,6 @@
+#!/bin/bash
+# variant 8: long-context LM. Examples:
+#   bash scripts/8.run.sh                          # data parallel
+#   bash scripts/8.run.sh --mesh data=2,seq=4      # ring-attention sequence parallel
+#   bash scripts/8.run.sh --mesh data=4,model=2    # Megatron-style tensor parallel
+python scripts/8.lm_longcontext.py "$@"

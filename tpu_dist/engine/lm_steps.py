@@ -1,0 +1,140 @@
+"""Language-model train/eval steps: DP, DP x TP, and DP x SP (ring attention).
+
+Extends the image engine (tpu_dist.engine.steps) to token sequences — the
+long-context, model-parallel half of the framework the reference never had.
+Three step builders over the same TransformerLM weights:
+
+* :func:`make_lm_train_step` — jit over a (data[, model]) mesh. Batch sharded
+  on 'data'; with TP param shardings (tpu_dist.parallel.tp) GSPMD emits the
+  Megatron collectives. Works for pure DP (no 'model' axis) unchanged.
+* :func:`make_lm_sp_train_step` — shard_map over (data, seq): each device
+  holds a sequence shard, attention runs as a ring over 'seq'
+  (tpu_dist.parallel.ring_attention), grads/metrics psum over both axes.
+  This is the blockwise/ring long-context regime: per-device activation
+  memory scales with L/n_seq.
+
+Loss: next-token cross entropy; targets are inputs shifted by one INSIDE the
+step (the final position of each sequence-shard boundary is handled by
+masking the global last token only — interior shard boundaries stay valid
+because shifting happens on the global array before sharding in the SP path's
+host loader... no: tpu_dist shifts per-shard and passes the successor token
+of the shard explicitly; see make_lm_batches).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_dist.engine.state import TrainState
+from tpu_dist.engine.steps import _apply_update
+from tpu_dist.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+
+def lm_loss_and_metrics(logits, targets, mask):
+    """Per-token CE sums. logits (B,L,V) fp32; targets (B,L); mask (B,L)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss_sum = jnp.sum(nll * mask)
+    correct = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
+    return loss_sum, {
+        "loss_sum": loss_sum,
+        "correct1": jnp.sum(correct * mask),
+        "count": jnp.sum(mask),
+    }
+
+
+def make_lm_batches(tokens: np.ndarray):
+    """Host-side: (B, L+1) token rows -> (inputs (B,L), targets (B,L)).
+
+    Shifting happens BEFORE any sharding so sequence shards stay consistent:
+    each shard's targets include the first token of the next shard.
+    """
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
+                       donate: bool = True) -> Callable:
+    """jit step for DP — and for DP x TP when the TrainState was placed with
+    tpu_dist.parallel.tp.shard_lm_params (GSPMD propagates the param layout
+    and emits the Megatron collectives; the step code is identical)."""
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(data_axis))
+
+    def step(state: TrainState, inputs, targets, rng):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, inputs, train=True,
+                                 rngs={"dropout": dropout_rng})
+            mask = jnp.ones(targets.shape, jnp.float32)
+            loss_sum, metrics = lm_loss_and_metrics(logits, targets, mask)
+            return loss_sum / jnp.maximum(metrics["count"], 1.0), ({}, metrics)
+
+        (_, (stats, metrics)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        return _apply_update(tx, state, grads, stats, metrics)
+
+    # With TP the state arrives pre-sharded (tpu_dist.parallel.tp.shard_lm_params)
+    # and in_shardings=None lets GSPMD propagate that layout through the step;
+    # pure DP states arrive replicated — same jit serves both.
+    return jax.jit(step, in_shardings=(None, batch_sh, batch_sh, repl),
+                   out_shardings=None,
+                   donate_argnums=(0,) if donate else ())
+
+
+def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,
+                          data_axis: str = DATA_AXIS,
+                          seq_axis: str = SEQ_AXIS,
+                          donate: bool = True) -> Callable:
+    """shard_map step: batch on 'data', sequence on 'seq', ring attention.
+
+    ``model_ctor(attn_fn)`` builds the model with the given attention fn so
+    the ring can be bound per-axis (tpu_dist.models.transformer.tiny_lm or a
+    partial of TransformerLM).
+    """
+    from tpu_dist.parallel.ring_attention import ring_attention_fn
+
+    model = model_ctor(attn_fn=ring_attention_fn(seq_axis))
+    n_seq = mesh.shape[seq_axis]
+
+    def per_device(state: TrainState, inputs, targets, rng):
+        seq_idx = jax.lax.axis_index(seq_axis)
+        dp_idx = jax.lax.axis_index(data_axis)
+        dropout_rng = jax.random.fold_in(
+            jax.random.fold_in(jax.random.fold_in(rng, state.step), seq_idx),
+            dp_idx)
+        shard_len = inputs.shape[1]
+        pos_offset = seq_idx * shard_len
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, inputs, train=True,
+                                 rngs={"dropout": dropout_rng},
+                                 pos_offset=pos_offset)
+            mask = jnp.ones(targets.shape, jnp.float32)
+            loss_sum, metrics = lm_loss_and_metrics(logits, targets, mask)
+            # LOCAL mean; collectives stay OUT of the differentiated function
+            # (psum's transpose under shard_map would rescale the cotangent).
+            # Equal static shard sizes make mean-of-local-means == global mean.
+            return loss_sum / jnp.maximum(metrics["count"], 1.0), ({}, metrics)
+
+        (_, (stats, metrics)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        grads = jax.tree.map(
+            lambda g: jax.lax.pmean(jax.lax.pmean(g, seq_axis), data_axis), grads)
+        metrics = jax.tree.map(
+            lambda m: jax.lax.psum(jax.lax.psum(m, seq_axis), data_axis), metrics)
+        return _apply_update(tx, state, grads, stats, metrics)
+
+    sharded = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -51,6 +51,11 @@ class Trainer:
             raise FileNotFoundError(f"--resume checkpoint not found: {cfg.resume}")
         if cfg.optimizer not in ("sgd", "fused_sgd"):
             raise ValueError(f"unknown optimizer {cfg.optimizer!r} (sgd|fused_sgd)")
+        from tpu_dist.models.registry import model_kind
+        if model_kind(cfg.arch) != "image":
+            raise ValueError(
+                f"--arch {cfg.arch} is a language model; this trainer drives "
+                "image classifiers — use scripts/8.lm_longcontext.py")
         if cfg.variant not in ("jit", "shard_map"):
             raise ValueError(f"unknown variant {cfg.variant!r} (jit|shard_map)")
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh_shape, cfg.mesh_axes)

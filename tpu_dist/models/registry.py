@@ -6,34 +6,48 @@ The reference selects any lowercase callable from
 explicit registry (no torchvision on TPU; ``--pretrained`` is accepted for CLI
 parity but there are no bundled weights in a zero-egress environment, so it
 raises a clear error instead of silently ignoring the flag).
+
+Each entry carries its *kind* ("image" classifier vs "lm") so construction
+and engine dispatch stay in one place: image ctors take ``num_classes``, LM
+ctors take vocab/layer kwargs, and the image Trainer refuses LM archs with a
+clear error instead of crashing inside flax init.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict
+from typing import Callable, Dict, Tuple
 
 import jax.numpy as jnp
 
-from tpu_dist.models import lenet, resnet
+from tpu_dist.models import lenet, resnet, transformer
 
-_REGISTRY: Dict[str, Callable] = {
-    "resnet18": resnet.ResNet18,
-    "resnet34": resnet.ResNet34,
-    "resnet50": resnet.ResNet50,
-    "resnet101": resnet.ResNet101,
-    "resnet152": resnet.ResNet152,
-    "lenet": lenet.LeNet,
-    "mnist_net": lenet.LeNet,  # reference 5.2 'Net' alias
+# name -> (constructor, kind)
+_REGISTRY: Dict[str, Tuple[Callable, str]] = {
+    "resnet18": (resnet.ResNet18, "image"),
+    "resnet34": (resnet.ResNet34, "image"),
+    "resnet50": (resnet.ResNet50, "image"),
+    "resnet101": (resnet.ResNet101, "image"),
+    "resnet152": (resnet.ResNet152, "image"),
+    "lenet": (lenet.LeNet, "image"),
+    "mnist_net": (lenet.LeNet, "image"),  # reference 5.2 'Net' alias
+    "transformer_lm": (transformer.TransformerLM, "lm"),
+    "tiny_lm": (transformer.tiny_lm, "lm"),
 }
 
 model_names = sorted(_REGISTRY)  # reference 1.dataparallel.py:23-24 equivalent
 
 
-def register(name: str):
+def register(name: str, kind: str = "image"):
     def deco(ctor: Callable):
-        _REGISTRY[name] = ctor
+        _REGISTRY[name] = (ctor, kind)
         return ctor
     return deco
+
+
+def model_kind(arch: str) -> str:
+    if arch not in _REGISTRY:
+        raise ValueError(f"unknown arch {arch!r}; choose from {model_names}")
+    return _REGISTRY[arch][1]
 
 
 def create_model(arch: str, num_classes: int = 10, dtype=jnp.float32,
@@ -42,6 +56,8 @@ def create_model(arch: str, num_classes: int = 10, dtype=jnp.float32,
         raise ValueError(
             "--pretrained requires downloaded weights; this environment has no "
             "egress. Train from scratch or point --resume at a checkpoint.")
-    if arch not in _REGISTRY:
-        raise ValueError(f"unknown arch {arch!r}; choose from {model_names}")
-    return _REGISTRY[arch](num_classes=num_classes, dtype=dtype, **kwargs)
+    kind = model_kind(arch)
+    ctor = _REGISTRY[arch][0]
+    if kind == "lm":
+        return ctor(dtype=dtype, **kwargs)  # vocab_size etc. via kwargs
+    return ctor(num_classes=num_classes, dtype=dtype, **kwargs)

@@ -1,0 +1,108 @@
+"""Causal transformer LM family (long-context / parallelism testbed).
+
+The reference trains only CNN image classifiers (SURVEY.md §2c: no attention,
+no sequence dimension anywhere). tpu_dist adds a transformer family because
+long-context and model parallelism are first-class in this framework: this
+model is the substrate for sequence parallelism (ring attention over a 'seq'
+mesh axis — tpu_dist.parallel.ring_attention) and tensor parallelism (head/
+mlp sharding over a 'model' axis — tpu_dist.parallel.tp).
+
+TPU-first design choices:
+* pre-LN blocks, GELU MLP (4x), learned positional embeddings — all shapes
+  static, MXU-friendly (head_dim and mlp sized in multiples of 128 at real
+  scales);
+* ``attn_fn`` is pluggable: the module computes qkv/out projections and
+  delegates the attention contraction, so the SAME parameters run under full
+  attention (single device), ring attention (seq-sharded shard_map), or any
+  future pallas flash kernel — sharding changes never touch the weights;
+* fp32 softmax/logits regardless of compute dtype.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def full_attention(q, k, v, *, causal: bool = True,
+                   q_offset: int = 0, kv_offset: int = 0):
+    """Reference attention: (B, L, H, D) tensors, fp32 softmax.
+
+    ``q_offset``/``kv_offset`` give the global position of the first row of
+    q/k when the sequence axis is sharded (ring attention passes these).
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(d).astype(jnp.float32)
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])[:, None]
+        kpos = kv_offset + jnp.arange(k.shape[1])[None, :]
+        scores = jnp.where(kpos <= qpos, scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
+
+
+class Block(nn.Module):
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+    attn_fn: Callable = full_attention
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        qkv = nn.Dense(3 * d_model, use_bias=False, dtype=self.dtype,
+                       name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (x.shape[0], x.shape[1], self.num_heads, head_dim)
+        out = self.attn_fn(q.reshape(shp), k.reshape(shp), v.reshape(shp))
+        out = out.reshape(x.shape)
+        x = x + nn.Dense(d_model, use_bias=False, dtype=self.dtype,
+                         name="proj")(out)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(4 * d_model, dtype=self.dtype, name="mlp_in")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(d_model, dtype=self.dtype, name="mlp_out")(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM. Input: int32 tokens (B, L); output fp32 logits."""
+
+    vocab_size: int = 32000
+    num_layers: int = 4
+    d_model: int = 256
+    num_heads: int = 8
+    max_len: int = 2048
+    dtype: jnp.dtype = jnp.float32
+    attn_fn: Callable = full_attention
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True, pos_offset=0):
+        # pos_offset: global position of this shard's first token (sequence
+        # parallelism passes axis_index * shard_len, a traced scalar; 0 when
+        # the sequence axis is unsharded)
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                     name="tok_emb")(tokens)
+        pos = pos_offset + jnp.arange(tokens.shape[1])
+        x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
+                         name="pos_emb")(pos)[None]
+        for i in range(self.num_layers):
+            x = Block(self.num_heads, self.dtype, self.attn_fn,
+                      name=f"block{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def tiny_lm(vocab_size=256, num_layers=2, d_model=64, num_heads=4,
+            max_len=512, dtype=jnp.float32, attn_fn=full_attention, **_):
+    return TransformerLM(vocab_size=vocab_size, num_layers=num_layers,
+                        d_model=d_model, num_heads=num_heads, max_len=max_len,
+                        dtype=dtype, attn_fn=attn_fn)

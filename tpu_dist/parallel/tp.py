@@ -1,0 +1,55 @@
+"""Tensor-parallel sharding rules (Megatron-style, compiler-partitioned).
+
+The reference has no tensor parallelism (SURVEY.md §2c: ABSENT upstream);
+tpu_dist provides it the TPU way: declare PartitionSpecs for the transformer
+weights over a 'model' mesh axis and let GSPMD insert the collectives —
+column-parallel first projection, row-parallel second projection, so each
+block needs exactly one all-reduce (attention) + one (MLP), the Megatron
+pattern, emitted by XLA rather than hand-written NCCL.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_dist.parallel.mesh import MODEL_AXIS
+
+# path-substring -> spec for TransformerLM params (kernels are (in, out))
+_RULES = (
+    ("qkv", P(None, MODEL_AXIS)),       # column-parallel: heads split
+    ("proj", P(MODEL_AXIS, None)),      # row-parallel: partial sums psum'd
+    ("mlp_in", P(None, MODEL_AXIS)),    # column-parallel
+    ("mlp_out", P(MODEL_AXIS, None)),   # row-parallel
+    ("lm_head", P(None, MODEL_AXIS)),   # vocab-sharded logits
+)
+
+
+def _spec_for(path: str, leaf) -> P:
+    for key, spec in _RULES:
+        if key in path and leaf.ndim == len(spec):
+            return spec
+    return P()  # replicate everything else (norms, embeddings, biases)
+
+
+def lm_param_specs(params) -> Any:
+    """PartitionSpec pytree for TransformerLM params."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    specs = {}
+    def build(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {k: build(v, prefix + "/" + str(k)) for k, v in tree.items()}
+        return _spec_for(prefix, tree)
+    return build(params)
+
+
+def lm_param_shardings(mesh: Mesh, params) -> Any:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), lm_param_specs(params),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_lm_params(mesh: Mesh, params):
+    """device_put params onto their TP shardings."""
+    return jax.device_put(params, lm_param_shardings(mesh, params))
