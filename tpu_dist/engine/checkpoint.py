@@ -32,14 +32,15 @@ def _to_host(tree):
 
 
 def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
-                    arch: str, is_best: bool) -> Optional[str]:
+                    arch: str, is_best: bool,
+                    extra_meta: Optional[Dict] = None) -> Optional[str]:
     """Process-0 atomic save; returns path (None on non-zero processes)."""
     if jax.process_index() != 0:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
     path = os.path.join(ckpt_dir, f"{arch}-checkpoint.msgpack")
     meta = {"epoch": epoch, "arch": arch, "best_acc1": float(best_acc1),
-            "step": int(jax.device_get(state.step))}
+            "step": int(jax.device_get(state.step)), **(extra_meta or {})}
     blob = serialization.to_bytes(_to_host(state))
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:

@@ -127,6 +127,7 @@ class Trainer:
         self.batch_sharding = batch_sharding(self.mesh)
         self.best_acc1 = 0.0
         self.start_epoch = cfg.start_epoch
+        self._skip_batches = 0
         self.is_main = jax.process_index() == 0
 
         if cfg.resume:
@@ -135,6 +136,22 @@ class Trainer:
             self.start_epoch = meta.get("epoch", 0)
             self.best_acc1 = meta.get("best_acc1", 0.0)
             self.log(f"=> resumed from {cfg.resume} (epoch {self.start_epoch})")
+            # mid-epoch (interrupt) checkpoint: the sampler's per-epoch
+            # permutation is deterministic, so resume is STEP-exact — derive
+            # the true epoch from the step counter and skip the batches whose
+            # updates are already in the state (no double-applied gradients,
+            # no LR-schedule drift). Covers interrupts during validation too
+            # (training complete -> next epoch, zero skips). The reference
+            # had no resume at all.
+            if meta.get("mid_epoch"):
+                step_done = int(jax.device_get(self.state.step))
+                self.start_epoch = step_done // self.steps_per_epoch
+                self._skip_batches = step_done % self.steps_per_epoch
+                if self._skip_batches:
+                    self.log(f"=> mid-epoch checkpoint: resuming epoch "
+                             f"{self.start_epoch}, skipping "
+                             f"{self._skip_batches} already-applied batches")
+        self._epoch_in_progress = self.start_epoch
 
     # ------------------------------------------------------------------
     def log(self, *a, **k):
@@ -163,10 +180,15 @@ class Trainer:
         top5 = AverageMeter("Acc@5", ":6.3f")
         progress = ProgressMeter(nb, [batch_time, data_time, losses, top1, top5],
                                  prefix=f"Epoch: [{epoch}]")
+        skip = self._skip_batches
+        self._skip_batches = 0
         pending = []
         end = time.time()
         it = prefetch_to_device(iter(loader), self.batch_sharding)
         for i, (images, labels) in enumerate(it):
+            if i < skip:  # step-exact resume of a mid-epoch checkpoint
+                end = time.time()
+                continue
             data_time.update(time.time() - end)
             self.state, metrics = self.train_step(
                 self.state, images, labels, self.rng)
@@ -220,22 +242,18 @@ class Trainer:
             jax.profiler.start_trace(cfg.profile_dir)
         csv_path = cfg.log_csv or ""
         try:
-            for epoch in range(self.start_epoch, cfg.epochs):
-                t0 = time.time()
-                train_metrics = self.train_epoch(epoch)
-                acc1 = self.validate(epoch)
-                epoch_secs = time.time() - t0
-                is_best = acc1 > self.best_acc1
-                self.best_acc1 = max(acc1, self.best_acc1)
-                if csv_path and self.is_main:
-                    # reference CSV format: [wall start, epoch seconds]
-                    with open(csv_path, "a+", newline="") as f:
-                        csv.writer(f).writerow([t0, epoch_secs])
-                ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, epoch + 1,
-                                     self.best_acc1, cfg.arch, is_best)
-                self.log(f"Epoch {epoch}: train_loss={train_metrics['loss']:.4f} "
-                         f"val_acc1={acc1 * 100:.3f} best={self.best_acc1 * 100:.3f} "
-                         f"({epoch_secs:.1f}s)")
+            self._fit_epochs(csv_path)
+        except KeyboardInterrupt:
+            # strictly better than the reference (no try/except around its
+            # training at all, SURVEY.md §5 'Failure detection'): an interrupt
+            # leaves a resumable checkpoint instead of losing the run
+            ckpt.save_checkpoint(cfg.checkpoint_dir, self.state,
+                                 self._epoch_in_progress, self.best_acc1,
+                                 cfg.arch, is_best=False,
+                                 extra_meta={"mid_epoch": True})
+            self.log(f"interrupted — checkpoint saved at epoch "
+                     f"{self._epoch_in_progress}; resume with --resume")
+            raise
         finally:
             if profiling:
                 # flush the trace even on OOM/interrupt — a failing run is
@@ -243,3 +261,23 @@ class Trainer:
                 import jax.profiler
                 jax.profiler.stop_trace()
         return self.best_acc1
+
+    def _fit_epochs(self, csv_path: str) -> None:
+        cfg = self.cfg
+        for epoch in range(self.start_epoch, cfg.epochs):
+            self._epoch_in_progress = epoch
+            t0 = time.time()
+            train_metrics = self.train_epoch(epoch)
+            acc1 = self.validate(epoch)
+            epoch_secs = time.time() - t0
+            is_best = acc1 > self.best_acc1
+            self.best_acc1 = max(acc1, self.best_acc1)
+            if csv_path and self.is_main:
+                # reference CSV format: [wall start, epoch seconds]
+                with open(csv_path, "a+", newline="") as f:
+                    csv.writer(f).writerow([t0, epoch_secs])
+            ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, epoch + 1,
+                                 self.best_acc1, cfg.arch, is_best)
+            self.log(f"Epoch {epoch}: train_loss={train_metrics['loss']:.4f} "
+                     f"val_acc1={acc1 * 100:.3f} best={self.best_acc1 * 100:.3f} "
+                     f"({epoch_secs:.1f}s)")
