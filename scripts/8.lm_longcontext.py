@@ -47,6 +47,9 @@ def main():
     ap.add_argument("--lr", type=float, default=3e-2)
     ap.add_argument("--precision", default="fp32", choices=["fp32", "bf16"])
     ap.add_argument("--print-freq", type=int, default=10)
+    ap.add_argument("--fsdp", action="store_true",
+                    help="shard params+optimizer state over the data axis "
+                         "(ZeRO-3 placement; same step function)")
     args = ap.parse_args()
 
     from tpu_dist.parallel import launch
@@ -81,6 +84,9 @@ def main():
 
     use_sp = "seq" in mesh.axis_names and mesh.shape["seq"] > 1
     use_tp = "model" in mesh.axis_names and mesh.shape["model"] > 1
+    if args.fsdp and (use_sp or use_tp):
+        print("warning: --fsdp applies to the pure data-parallel layout; "
+              "ignored with a seq/model mesh axis", flush=True)
     if use_sp:
         step = make_lm_sp_train_step(partial(tiny_lm, **lm_kw), tx, mesh)
         data_spec = P("data", "seq")
@@ -95,6 +101,9 @@ def main():
                 opt_state=jax.device_put(state.opt_state,
                                          NamedSharding(mesh, P())),
                 loss_scale=None)
+        elif args.fsdp:
+            from tpu_dist.parallel.fsdp import shard_state_fsdp
+            state = shard_state_fsdp(mesh, state)
         else:
             state = jax.device_put(state, replicated(mesh))
 
@@ -112,7 +121,8 @@ def main():
     inputs = jax.device_put(inputs, sh)
     targets = jax.device_put(targets, sh)
 
-    mode = "sp-ring" if use_sp else ("tp" if use_tp else "dp")
+    mode = "sp-ring" if use_sp else ("tp" if use_tp else
+                                     ("fsdp" if args.fsdp else "dp"))
     if jax.process_index() == 0:
         print(f"[proc {info.process_id}/{info.num_processes}] mesh={dict(mesh.shape)} "
               f"mode={mode} tokens/step={args.batch_size * args.seq_len}")

@@ -1,0 +1,70 @@
+"""FSDP / ZeRO-style parameter + optimizer-state sharding (GSPMD-partitioned).
+
+Absent from the reference (DP-only, SURVEY.md §2c). TPU-first FSDP is a
+*placement policy*, not a wrapper class: shard every sizeable weight (and its
+optimizer state) along one dimension over the data axis and let GSPMD insert
+the all-gathers before use and reduce-scatters for gradients — the same
+math as ZeRO-3, expressed as shardings. Per-device param+optimizer memory
+drops ~n_data-fold; the step function is untouched.
+
+Rule: shard the largest dimension divisible by the axis size; replicate small
+leaves (norms, biases) where sharding would only add latency.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_dist.parallel.mesh import DATA_AXIS
+
+
+def _leaf_spec(shape, axis_size: int, axis: str, min_size: int) -> P:
+    if int(np.prod(shape)) < min_size or not shape:
+        return P()
+    # largest dim divisible by the axis size wins; ties -> earliest
+    best = None
+    for i, d in enumerate(shape):
+        if d % axis_size == 0 and (best is None or d > shape[best]):
+            best = i
+    if best is None:
+        return P()
+    return P(*[axis if i == best else None for i in range(len(shape))])
+
+
+def fsdp_specs(tree, axis_size: int, axis: str = DATA_AXIS,
+               min_size: int = 1024) -> Any:
+    """PartitionSpec pytree for params OR optimizer state (shape-driven, so
+    the same rule shards momentum buffers identically to their params)."""
+    return jax.tree.map(
+        lambda leaf: _leaf_spec(leaf.shape, axis_size, axis, min_size), tree)
+
+
+def fsdp_shardings(mesh: Mesh, tree, axis: str = DATA_AXIS,
+                   min_size: int = 1024) -> Any:
+    n = mesh.shape[axis]
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        fsdp_specs(tree, n, axis, min_size),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_state_fsdp(mesh: Mesh, state, axis: str = DATA_AXIS,
+                     min_size: int = 1024):
+    """Place a TrainState with params+opt_state FSDP-sharded, scalars replicated."""
+    from tpu_dist.engine.state import TrainState
+
+    repl = NamedSharding(mesh, P())
+    return TrainState(
+        step=jax.device_put(state.step, repl),
+        params=jax.device_put(state.params,
+                              fsdp_shardings(mesh, state.params, axis, min_size)),
+        batch_stats=jax.device_put(state.batch_stats, repl),
+        opt_state=jax.device_put(state.opt_state,
+                                 fsdp_shardings(mesh, state.opt_state, axis,
+                                                min_size)),
+        loss_scale=(None if state.loss_scale is None
+                    else jax.device_put(state.loss_scale, repl)))
