@@ -50,6 +50,10 @@ def main():
     ap.add_argument("--fsdp", action="store_true",
                     help="shard params+optimizer state over the data axis "
                          "(ZeRO-3 placement; same step function)")
+    ap.add_argument("--num-experts", type=int, default=0,
+                    help="MoE feed-forward with N experts (0 = dense); with "
+                         "--mesh data=2,expert=4 experts shard over the "
+                         "'expert' axis (GShard-style expert parallelism)")
     args = ap.parse_args()
 
     from tpu_dist.parallel import launch
@@ -75,7 +79,11 @@ def main():
     lm_kw = dict(vocab_size=args.vocab_size, num_layers=args.num_layers,
                  d_model=args.d_model, num_heads=args.num_heads,
                  max_len=args.seq_len, dtype=policy.compute_dtype)
-    model = tiny_lm(**lm_kw)
+    if args.num_experts:
+        from tpu_dist.models.moe import MoETransformerLM
+        model = MoETransformerLM(num_experts=args.num_experts, **lm_kw)
+    else:
+        model = tiny_lm(**lm_kw)
     params = model.init({"params": jax.random.PRNGKey(0)},
                         jnp.zeros((1, args.seq_len), jnp.int32),
                         train=False)["params"]
@@ -84,9 +92,19 @@ def main():
 
     use_sp = "seq" in mesh.axis_names and mesh.shape["seq"] > 1
     use_tp = "model" in mesh.axis_names and mesh.shape["model"] > 1
-    if args.fsdp and (use_sp or use_tp):
+    use_ep = "expert" in mesh.axis_names and mesh.shape["expert"] > 1
+    if args.fsdp and (use_sp or use_tp or use_ep):
         print("warning: --fsdp applies to the pure data-parallel layout; "
-              "ignored with a seq/model mesh axis", flush=True)
+              "ignored with a seq/model/expert mesh axis", flush=True)
+    if use_ep and not args.num_experts:
+        raise SystemExit("an 'expert' mesh axis requires --num-experts > 0")
+    if use_sp and args.num_experts:
+        raise SystemExit("MoE + sequence parallelism not supported yet "
+                         "(ring attention path builds the dense model)")
+    if use_tp and args.num_experts:
+        raise SystemExit("MoE + tensor parallelism not supported: the TP "
+                         "rules don't shard 3-D expert weights — use "
+                         "--mesh data=N,expert=M instead")
     if use_sp:
         step = make_lm_sp_train_step(partial(tiny_lm, **lm_kw), tx, mesh)
         data_spec = P("data", "seq")
@@ -94,7 +112,10 @@ def main():
     else:
         step = make_lm_train_step(model, tx, mesh)
         data_spec = P("data")
-        if use_tp:
+        if use_ep:
+            from tpu_dist.parallel.ep import shard_state_ep
+            state = shard_state_ep(mesh, state)
+        elif use_tp:
             state = TrainState(
                 step=jax.device_put(state.step, NamedSharding(mesh, P())),
                 params=shard_lm_params(mesh, state.params), batch_stats={},
@@ -121,8 +142,11 @@ def main():
     inputs = jax.device_put(inputs, sh)
     targets = jax.device_put(targets, sh)
 
-    mode = "sp-ring" if use_sp else ("tp" if use_tp else
-                                     ("fsdp" if args.fsdp else "dp"))
+    mode = ("sp-ring" if use_sp else
+            "ep-moe" if use_ep else
+            "tp" if use_tp else
+            "fsdp" if args.fsdp else
+            ("dp-moe" if args.num_experts else "dp"))
     if jax.process_index() == 0:
         print(f"[proc {info.process_id}/{info.num_processes}] mesh={dict(mesh.shape)} "
               f"mode={mode} tokens/step={args.batch_size * args.seq_len}")

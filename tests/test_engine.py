@@ -198,3 +198,40 @@ def test_multi_step_equals_sequential_steps():
     fb = np.concatenate([np.asarray(x).ravel() for x in jax.tree.leaves(s_multi.params)])
     np.testing.assert_allclose(fa, fb, rtol=1e-5, atol=1e-7)
     assert int(jax.device_get(s_multi.step)) == k
+
+
+def test_grad_accum_equals_big_batch():
+    """K microbatches accumulated == one step over the concatenated batch
+    (exact for batch-decoupled models)."""
+    from tpu_dist.engine.steps import make_grad_accum_train_step
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh()
+    model = _MLP()
+    params, stats = init_model(model, jax.random.PRNGKey(0), (2, 28, 28, 1))
+    tx = make_optimizer(0.1, 0.9, 1e-4, steps_per_epoch=1000)
+    state0 = jax.device_put(TrainState.create(params, stats, tx),
+                            replicated(mesh))
+    transform = make_transform(np.full((1,), 0.5, np.float32),
+                               np.full((1,), 0.25, np.float32))
+    big = make_train_step(model, tx, transform, mesh, donate=False)
+    accum = make_grad_accum_train_step(model, tx, transform, mesh,
+                                       donate=False)
+
+    k, b = 4, 16
+    imgs, labels = _batch(k * b)
+    key = jax.random.PRNGKey(3)
+    s_big, m_big = big(state0, jax.device_put(imgs, batch_sharding(mesh)),
+                       jax.device_put(labels, batch_sharding(mesh)), key)
+    sh2 = NamedSharding(mesh, P(None, "data"))
+    s_acc, m_acc = accum(state0,
+                         jax.device_put(imgs.reshape(k, b, 28, 28, 1), sh2),
+                         jax.device_put(labels.reshape(k, b), sh2), key)
+    assert float(jax.device_get(m_acc["count"])) == k * b
+    assert float(jax.device_get(m_acc["loss_sum"])) == pytest.approx(
+        float(jax.device_get(m_big["loss_sum"])), rel=1e-5)
+    fa = np.concatenate([np.asarray(x).ravel()
+                         for x in jax.tree.leaves(s_big.params)])
+    fb = np.concatenate([np.asarray(x).ravel()
+                         for x in jax.tree.leaves(s_acc.params)])
+    np.testing.assert_allclose(fa, fb, rtol=1e-5, atol=1e-7)

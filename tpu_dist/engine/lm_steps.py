@@ -50,6 +50,23 @@ def lm_loss_and_metrics(logits, targets, mask):
     }
 
 
+def _apply_collect_aux(model, params, inputs, dropout_rng, pos_offset=0):
+    """Forward pass that also collects sown MoE aux losses (zero if none).
+
+    Only leaves sown under the key ``aux_loss`` count — other intermediates
+    (diagnostics, router stats) must never leak into the objective.
+    """
+    logits, muts = model.apply(
+        {"params": params}, inputs, train=True, rngs={"dropout": dropout_rng},
+        pos_offset=pos_offset, mutable=["intermediates"])
+    aux = jnp.float32(0.0)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            muts.get("intermediates", {}))[0]:
+        if any(getattr(k, "key", None) == "aux_loss" for k in path):
+            aux = aux + jnp.sum(leaf)
+    return logits, aux
+
+
 def make_lm_batches(tokens: np.ndarray):
     """Host-side: (B, L+1) token rows -> (inputs (B,L), targets (B,L)).
 
@@ -60,10 +77,12 @@ def make_lm_batches(tokens: np.ndarray):
 
 
 def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
+                       aux_weight: float = 0.01,
                        donate: bool = True) -> Callable:
-    """jit step for DP — and for DP x TP when the TrainState was placed with
-    tpu_dist.parallel.tp.shard_lm_params (GSPMD propagates the param layout
-    and emits the Megatron collectives; the step code is identical)."""
+    """jit step for DP — and for DP x TP / FSDP / EP when the TrainState was
+    placed with the matching sharding helper (GSPMD propagates the param
+    layout and emits the collectives; the step code is identical).
+    ``aux_weight`` scales any sown MoE load-balancing losses."""
     repl = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(data_axis))
 
@@ -71,11 +90,11 @@ def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
         dropout_rng = jax.random.fold_in(rng, state.step)
 
         def loss_fn(p):
-            logits = model.apply({"params": p}, inputs, train=True,
-                                 rngs={"dropout": dropout_rng})
+            logits, aux = _apply_collect_aux(model, p, inputs, dropout_rng)
             mask = jnp.ones(targets.shape, jnp.float32)
             loss_sum, metrics = lm_loss_and_metrics(logits, targets, mask)
-            return loss_sum / jnp.maximum(metrics["count"], 1.0), ({}, metrics)
+            mean = loss_sum / jnp.maximum(metrics["count"], 1.0)
+            return mean + aux_weight * aux, ({}, metrics)
 
         (_, (stats, metrics)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
@@ -92,6 +111,7 @@ def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
 def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,
                           data_axis: str = DATA_AXIS,
                           seq_axis: str = SEQ_AXIS,
+                          aux_weight: float = 0.01,
                           donate: bool = True) -> Callable:
     """shard_map step: batch on 'data', sequence on 'seq', ring attention.
 
@@ -114,15 +134,15 @@ def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,
         pos_offset = seq_idx * shard_len
 
         def loss_fn(p):
-            logits = model.apply({"params": p}, inputs, train=True,
-                                 rngs={"dropout": dropout_rng},
-                                 pos_offset=pos_offset)
+            logits, aux = _apply_collect_aux(model, p, inputs, dropout_rng,
+                                             pos_offset=pos_offset)
             mask = jnp.ones(targets.shape, jnp.float32)
             loss_sum, metrics = lm_loss_and_metrics(logits, targets, mask)
             # LOCAL mean; collectives stay OUT of the differentiated function
             # (psum's transpose under shard_map would rescale the cotangent).
             # Equal static shard sizes make mean-of-local-means == global mean.
-            return loss_sum / jnp.maximum(metrics["count"], 1.0), ({}, metrics)
+            mean = loss_sum / jnp.maximum(metrics["count"], 1.0)
+            return mean + aux_weight * aux, ({}, metrics)
 
         (_, (stats, metrics)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)

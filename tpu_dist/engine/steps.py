@@ -184,6 +184,53 @@ def make_eval_step(model, transform, mesh: Mesh,
                    out_shardings=repl)
 
 
+def make_grad_accum_train_step(model, tx, transform, mesh: Mesh,
+                               data_axis: str = DATA_AXIS,
+                               donate: bool = True) -> Callable:
+    """ONE optimizer step from K microbatches (gradient accumulation).
+
+    signature: (state, images_u8 (K,B,...), labels (K,B), rng) -> (state,
+    metrics summed over microbatches). Grads are averaged over the K
+    microbatches inside a lax.scan, then applied once — the standard recipe
+    for global batches that exceed device memory (absent from the reference,
+    whose answer to batch 3200 was requiring 4x V100s). BN statistics advance
+    per microbatch (same semantics as torch accumulation loops).
+    """
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(None, data_axis))
+
+    def step(state: TrainState, images_u8, labels, rng):
+        k = images_u8.shape[0]
+        dropout_rng, aug_rng = jax.random.split(jax.random.fold_in(rng, state.step))
+
+        def micro(carry, batch):
+            grads_acc, stats, i = carry
+            imgs, lbls = batch
+            d_rng = jax.random.fold_in(dropout_rng, i)
+            a_rng = jax.random.fold_in(aug_rng, i)
+            grad_fn = jax.value_and_grad(
+                lambda p: _loss_and_metrics(model, transform, p, stats,
+                                            imgs, lbls, d_rng, a_rng,
+                                            state.loss_scale, True),
+                has_aux=True)
+            (_, (new_stats, metrics)), grads = grad_fn(state.params)
+            grads_acc = jax.tree.map(lambda a, g: a + g / k, grads_acc, grads)
+            return (grads_acc, new_stats, i + 1), metrics
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             state.params)
+        (grads, new_stats, _), metrics_k = jax.lax.scan(
+            micro, (zeros, state.batch_stats, jnp.int32(0)),
+            (images_u8, labels))
+        metrics = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
+        return _apply_update(tx, state, grads, new_stats, metrics)
+
+    return jax.jit(step,
+                   in_shardings=(None, batch_sh, batch_sh, repl),
+                   out_shardings=(None, repl),
+                   donate_argnums=(0,) if donate else ())
+
+
 def make_shard_map_train_step(model, tx, transform, mesh: Mesh,
                               data_axis: str = DATA_AXIS,
                               grad_compression: str = "none",

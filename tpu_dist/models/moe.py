@@ -1,0 +1,147 @@
+"""Mixture-of-Experts MLP + expert parallelism (GShard/Switch-style).
+
+Absent from the reference (SURVEY.md §2c: EP/MoE ABSENT). TPU-first MoE is
+the GShard dispatch pattern: top-1 (Switch) gating, fixed expert capacity so
+every shape is static, and one-hot dispatch/combine einsums that XLA turns
+into all-to-alls when the expert dimension is sharded over the ``expert``
+mesh axis (tpu_dist.parallel.ep) — no dynamic gather/scatter, no host
+routing.
+
+Load-balancing: the Switch auxiliary loss (fraction-of-tokens x mean-gate
+per expert) is ``sow``n into the 'intermediates' collection under
+``aux_loss``; the LM train step picks every sown aux_loss up generically and
+adds ``aux_weight`` times their sum to the objective.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMLP(nn.Module):
+    """Switch-style MoE feed-forward: top-1 gate, capacity-bounded dispatch.
+
+    Input (B, L, D) -> (B, L, D). Expert weights carry a leading experts dim
+    sharded over the 'expert' axis by tpu_dist.parallel.ep.ep_param_specs.
+
+    GShard grouping: tokens are processed in groups of ``group_size`` with
+    per-group capacity, so the dispatch/combine tensors are (G, S, E, C) with
+    C = S/E * factor — memory O(T * S * factor) instead of the O(T^2) a
+    global dispatch would cost, and the cumsum that assigns capacity slots is
+    group-local (no cross-shard sequential dependency when the group dim is
+    sharded over 'data'). Dispatch one-hots are kept in the compute dtype
+    (bf16 halves their footprint under the bf16 policy).
+    """
+
+    num_experts: int = 4
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    group_size: int = 512
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, l, d = x.shape
+        t = b * l
+        e = self.num_experts
+        f = self.mlp_ratio * d
+        s = min(self.group_size, t)
+        if t % s:  # group size must divide tokens; fall back to batch rows
+            s = l
+        g = t // s
+        cap = max(1, int(s / e * self.capacity_factor))
+
+        tokens = x.reshape(g, s, d)
+        gate_logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
+                               name="gate")(tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(gate_logits, axis=-1)          # (G, S, E) fp32
+        expert_idx = jnp.argmax(probs, axis=-1)               # (G, S)
+        gate = jnp.max(probs, axis=-1)                        # (G, S)
+
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (G, S, E)
+        # position of each token in its expert's queue within the group
+        pos = jnp.cumsum(onehot, axis=1) * onehot - onehot
+        keep = (pos < cap).astype(jnp.float32) * onehot
+        # dispatch tensor (G, S, E, C): one-hot over capacity slots
+        disp = keep[..., None] * jax.nn.one_hot(pos, cap, dtype=jnp.float32)
+
+        # Switch aux loss: E * sum_e( token_fraction_e * mean_prob_e )
+        frac = jnp.mean(onehot, axis=(0, 1))
+        mean_prob = jnp.mean(probs, axis=(0, 1))
+        self.sow("intermediates", "aux_loss", e * jnp.sum(frac * mean_prob))
+
+        w_in = self.param("w_in", nn.initializers.lecun_normal(),
+                          (e, d, f)).astype(self.dtype)
+        w_out = self.param("w_out", nn.initializers.lecun_normal(),
+                           (e, f, d)).astype(self.dtype)
+
+        disp_c = disp.astype(self.dtype)
+        expert_in = jnp.einsum("gsec,gsd->gecd", disp_c,
+                               tokens.astype(self.dtype))      # (G, E, C, D)
+        h = jnp.einsum("gecd,edf->gecf", expert_in, w_in)
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("gecf,efd->gecd", h, w_out)    # (G, E, C, D)
+        combine = disp_c * gate[..., None, None].astype(self.dtype)
+        out = jnp.einsum("gsec,gecd->gsd", combine, expert_out)
+        # dropped tokens (over capacity) pass through the residual unchanged
+        return out.reshape(b, l, d)
+
+
+class MoEBlock(nn.Module):
+    """Transformer block whose MLP is a MoEMLP (attention unchanged)."""
+
+    num_heads: int
+    num_experts: int = 4
+    dtype: jnp.dtype = jnp.float32
+    attn_fn: Callable = None  # default set in __call__ to avoid import cycle
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        from tpu_dist.models.transformer import full_attention
+
+        attn = self.attn_fn or full_attention
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        qkv = nn.Dense(3 * d_model, use_bias=False, dtype=self.dtype,
+                       name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (x.shape[0], x.shape[1], self.num_heads, head_dim)
+        out = attn(q.reshape(shp), k.reshape(shp), v.reshape(shp))
+        x = x + nn.Dense(d_model, use_bias=False, dtype=self.dtype,
+                         name="proj")(out.reshape(x.shape))
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        x = x + MoEMLP(self.num_experts, dtype=self.dtype, name="moe")(h, train)
+        return x
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only LM with MoE feed-forward in every block."""
+
+    vocab_size: int = 256
+    num_layers: int = 2
+    d_model: int = 64
+    num_heads: int = 4
+    num_experts: int = 4
+    max_len: int = 512
+    dtype: jnp.dtype = jnp.float32
+    attn_fn: Callable = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True, pos_offset=0):
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                     name="tok_emb")(tokens)
+        pos = pos_offset + jnp.arange(tokens.shape[1])
+        x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
+                         name="pos_emb")(pos)[None]
+        for i in range(self.num_layers):
+            x = MoEBlock(self.num_heads, self.num_experts, self.dtype,
+                         self.attn_fn, name=f"block{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)

@@ -19,7 +19,7 @@ from typing import Callable, Dict, Tuple
 
 import jax.numpy as jnp
 
-from tpu_dist.models import lenet, resnet, transformer
+from tpu_dist.models import lenet, moe, resnet, transformer
 
 # name -> (constructor, kind)
 _REGISTRY: Dict[str, Tuple[Callable, str]] = {
@@ -32,6 +32,7 @@ _REGISTRY: Dict[str, Tuple[Callable, str]] = {
     "mnist_net": (lenet.LeNet, "image"),  # reference 5.2 'Net' alias
     "transformer_lm": (transformer.TransformerLM, "lm"),
     "tiny_lm": (transformer.tiny_lm, "lm"),
+    "moe_lm": (moe.MoETransformerLM, "lm"),
 }
 
 model_names = sorted(_REGISTRY)  # reference 1.dataparallel.py:23-24 equivalent

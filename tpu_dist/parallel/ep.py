@@ -1,0 +1,73 @@
+"""Expert-parallel sharding rules (GShard-style, compiler-partitioned).
+
+Shards every MoE expert weight's leading experts dimension over the
+``expert`` mesh axis; GSPMD turns the dispatch/combine einsums
+(tpu_dist.models.moe) into the all-to-all exchanges of classic expert
+parallelism. Everything non-expert stays replicated (or combines with the
+other axes' specs when meshes are stacked).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+EXPERT_AXIS = "expert"
+
+
+def ep_param_specs(params, axis: str = EXPERT_AXIS) -> Any:
+    """P(axis, ...) for expert weights (w_in/w_out carry the leading experts
+    dim — tpu_dist.models.moe.MoEMLP); P() for everything else, including the
+    gate projection (its dim 0 is d_model, not experts)."""
+    def build(tree, key=""):
+        if isinstance(tree, dict):
+            return {k: build(v, k) for k, v in tree.items()}
+        if key in ("w_in", "w_out") and tree.ndim == 3:
+            return P(axis, None, None)
+        return P()
+    return build(params)
+
+
+def shard_moe_params(mesh: Mesh, params, axis: str = EXPERT_AXIS):
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                             ep_param_specs(params, axis),
+                             is_leaf=lambda x: isinstance(x, P))
+    return jax.device_put(params, shardings)
+
+
+def shard_state_ep(mesh: Mesh, state, axis: str = EXPERT_AXIS):
+    """Place a TrainState for expert parallelism: expert weights AND their
+    optimizer state sharded over ``axis`` (the momentum buffers are the bulk
+    of an MoE model's memory — leaving them replicated would defeat EP's
+    scaling); everything else replicated.
+
+    Optimizer-state leaves don't carry the param path names, so expert leaves
+    are recognized by shape: any leaf whose shape matches an expert weight's.
+    """
+    from tpu_dist.engine.state import TrainState
+
+    expert_shapes = set()
+    def collect(tree, key=""):
+        if isinstance(tree, dict):
+            [collect(v, k) for k, v in tree.items()]
+        elif key in ("w_in", "w_out") and tree.ndim == 3:
+            expert_shapes.add(tree.shape)
+    collect(state.params)
+
+    repl = NamedSharding(mesh, P())
+    exp = lambda nd: NamedSharding(mesh, P(*([axis] + [None] * (nd - 1))))
+
+    def place(leaf):
+        if hasattr(leaf, "shape") and leaf.shape in expert_shapes:
+            return jax.device_put(leaf, exp(leaf.ndim))
+        return jax.device_put(leaf, repl)
+
+    return TrainState(
+        step=jax.device_put(state.step, repl),
+        params=shard_moe_params(mesh, state.params, axis),
+        batch_stats=jax.device_put(state.batch_stats, repl),
+        opt_state=jax.tree.map(place, state.opt_state),
+        loss_scale=(None if state.loss_scale is None
+                    else jax.device_put(state.loss_scale, repl)))
