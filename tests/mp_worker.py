@@ -1,0 +1,68 @@
+"""One worker process of a loopback multi-process run (test_multiprocess).
+
+The multi-controller analog of the reference's per-GPU worker
+(reference 3.multiprocessing_distributed.py:89-120: spawned child, tcp://
+rendezvous, DDP train loop). Each process owns a slice of virtual CPU
+devices, rendezvouses through tpu_dist.parallel.launch (env:// flavor), and
+drives the SAME Trainer as single-process runs — multi-host is decided by how
+the process was launched, not by the engine.
+
+Run via tests/test_multiprocess.py, which injects TPU_DIST_COORDINATOR /
+TPU_DIST_NUM_PROCESSES / TPU_DIST_PROCESS_ID and compares final parameters
+against the single-process run.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    out = os.environ["TPU_DIST_TEST_OUT"]
+    local_devices = int(os.environ.get("TPU_DIST_LOCAL_DEVICES", "2"))
+
+    import jax
+
+    # Per-process virtual CPU devices, pinned BEFORE the distributed client
+    # initializes the backend (same recipe as tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", local_devices)
+
+    from tpu_dist.parallel import launch
+
+    info = launch.initialize()
+    expected = int(os.environ.get("TPU_DIST_EXPECT_PROCS", "1"))
+    assert jax.process_count() == expected, (jax.process_count(), expected)
+    assert jax.local_device_count() == local_devices, jax.local_device_count()
+
+    import numpy as np
+
+    from tpu_dist.configs import TrainConfig
+    from tpu_dist.engine.loop import Trainer
+
+    cfg = TrainConfig(
+        arch="lenet", dataset="synthetic", epochs=1, batch_size=16, lr=0.05,
+        workers=1, print_freq=100, seed=0, synth_train_size=64,
+        synth_val_size=32, checkpoint_dir=os.path.join(out, "ckpt"),
+        variant=os.environ.get("TPU_DIST_TEST_VARIANT", "jit"))
+    trainer = Trainer(cfg)
+    best = trainer.fit()
+
+    # Replicated state: every process sees identical global values; process 0
+    # records them for the cross-run comparison.
+    if jax.process_index() == 0:
+        leaves = jax.tree_util.tree_leaves(jax.device_get(trainer.state.params))
+        np.savez(os.path.join(out, "params.npz"),
+                 **{f"p{i}": np.asarray(x, np.float32)
+                    for i, x in enumerate(leaves)})
+        with open(os.path.join(out, "result.json"), "w") as f:
+            json.dump({"best_acc1": float(best),
+                       "process_count": jax.process_count(),
+                       "method": info.method,
+                       "step": int(jax.device_get(trainer.state.step))}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,8 +11,15 @@ Reproduces ``torch.utils.data.DistributedSampler`` semantics TPU-first
   number of samples — torch pads to ``ceil(N / world) * world``; we
   additionally pad to a multiple of ``world * batch`` so every *batch* has a
   static shape (XLA requires static shapes for a single compiled step);
-* per-rank assignment is strided (``indices[rank::world]``) exactly like
-  torch, so shard contents match the reference's semantics.
+* per-rank assignment: with ``batch_size`` set, each *global batch* is a
+  contiguous window of the permutation and rank r takes rows
+  ``[r*B:(r+1)*B]`` of it, so the global device array assembled across
+  processes is ordering-identical to the single-process batch — an N-process
+  run reproduces the 1-process trajectory exactly (positional randomness like
+  dropout included; verified by tests/test_multiprocess.py). This is a
+  deliberate delta from torch's strided ``indices[rank::world]``, which
+  permutes samples within the global batch per world size; the strided
+  flavor is kept for the batch-unaware mode (``batch_size=None``).
 """
 
 from __future__ import annotations
@@ -77,6 +84,14 @@ class DistributedSampler:
                 idx = np.concatenate([idx] + [idx] * reps)[: self.total_size]
                 valid = np.concatenate(
                     [valid, np.zeros(self.total_size - len(valid), bool)])
+        if self.batch_size:
+            # batch-blocked: global batch b = idx[b*W*B:(b+1)*W*B]; rank r
+            # holds its contiguous sub-block, so cross-process assembly
+            # reconstructs the exact single-process ordering (see module doc)
+            def take(a: np.ndarray) -> np.ndarray:
+                blocks = a.reshape(-1, self.num_replicas, self.batch_size)
+                return blocks[:, self.rank, :].reshape(-1)
+            return take(idx), take(valid)
         return (idx[self.rank :: self.num_replicas],
                 valid[self.rank :: self.num_replicas])
 
