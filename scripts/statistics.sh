@@ -1,11 +1,19 @@
 #!/bin/bash
 # TPU telemetry sampler (reference statistics.sh:1-4 nvidia-smi 500ms CSV).
 # No nvidia-smi on TPU; device utilization/memory come from the JAX profiler
-# (--profile-dir) — this script samples host-side RSS + the libtpu runtime
-# metrics endpoint if present.
-OUT=${1:-tpu_log.csv}
+# (--profile-dir) — this script samples the TRAINING process's host RSS at the
+# same 500 ms cadence. Usage: statistics.sh <pid> [out.csv]; with no pid it
+# samples the newest python process running a scripts/*.py entrypoint.
+PID=${1:-$(pgrep -nf 'python.*scripts/.*\.py')}
+OUT=${2:-tpu_log.csv}
+if [ -z "$PID" ] || [ ! -d "/proc/$PID" ]; then
+  echo "statistics.sh: no training process found (pass a pid)" >&2
+  exit 1
+fi
 echo "ts,host_rss_kb" > "$OUT"
-while true; do
-  echo "$(date +%s.%N),$(grep VmRSS /proc/self/status | awk '{print $2}')" >> "$OUT"
+while [ -d "/proc/$PID" ]; do
+  RSS=$(awk '/VmRSS/{print $2}' "/proc/$PID/status" 2>/dev/null)
+  [ -n "$RSS" ] || break   # exited or zombie: no VmRSS line
+  echo "$(date +%s.%N),$RSS" >> "$OUT"
   sleep 0.5
 done

@@ -72,7 +72,8 @@ def gather_batch(images: np.ndarray, labels: np.ndarray,
     in_bounds = (idx_arr.size == 0 or
                  (idx_arr.min() >= 0 and idx_arr.max() < images.shape[0]))
     if lib is None or not images.flags.c_contiguous or not in_bounds:
-        return images[indices], labels[indices]
+        # int32 labels to match the native path's output dtype exactly
+        return images[indices], labels[indices].astype(np.int32)
     idx = np.ascontiguousarray(idx_arr, np.int64)
     n = idx.shape[0]
     row_bytes = images.dtype.itemsize * int(np.prod(images.shape[1:]))

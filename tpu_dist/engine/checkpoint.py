@@ -31,6 +31,12 @@ def _to_host(tree):
     return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
 
 
+# single-file container so blob+meta commit in ONE os.replace (a two-file
+# scheme always has a crash window that pairs a new blob with old meta):
+# MAGIC | u64-le meta_len | meta json | msgpack blob
+_MAGIC = b"TPUDIST1\n"
+
+
 def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
                     arch: str, is_best: bool,
                     extra_meta: Optional[Dict] = None) -> Optional[str]:
@@ -41,13 +47,20 @@ def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
     path = os.path.join(ckpt_dir, f"{arch}-checkpoint.msgpack")
     meta = {"epoch": epoch, "arch": arch, "best_acc1": float(best_acc1),
             "step": int(jax.device_get(state.step)), **(extra_meta or {})}
+    meta_bytes = json.dumps(meta).encode()
     blob = serialization.to_bytes(_to_host(state))
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        f.write(len(meta_bytes).to_bytes(8, "little"))
+        f.write(meta_bytes)
         f.write(blob)
     os.replace(tmp, path)
-    with open(path + ".json", "w") as f:
+    # sidecar json is a human-readable convenience only; load reads the
+    # embedded copy, so a crash here cannot desynchronize blob and meta
+    with open(path + ".json.tmp", "w") as f:
         json.dump(meta, f)
+    os.replace(path + ".json.tmp", path + ".json")
     if is_best:
         # reference shutil.copyfile to 'model_best' (1.dataparallel.py:287-288)
         shutil.copyfile(path, os.path.join(ckpt_dir, f"{arch}-model_best.msgpack"))
@@ -59,10 +72,17 @@ def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
 def load_checkpoint(path: str, template_state) -> Tuple[Any, Dict]:
     """Restore a TrainState saved by save_checkpoint into template's structure."""
     with open(path, "rb") as f:
-        state = serialization.from_bytes(template_state, f.read())
-    meta_path = path + ".json"
-    meta = {}
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
+        raw = f.read()
+    meta: Dict = {}
+    if raw.startswith(_MAGIC):
+        off = len(_MAGIC)
+        meta_len = int.from_bytes(raw[off:off + 8], "little")
+        meta = json.loads(raw[off + 8:off + 8 + meta_len])
+        blob = raw[off + 8 + meta_len:]
+    else:  # pre-container checkpoint: bare msgpack + sidecar json
+        blob = raw
+        if os.path.exists(path + ".json"):
+            with open(path + ".json") as f:
+                meta = json.load(f)
+    state = serialization.from_bytes(template_state, blob)
     return state, meta

@@ -68,11 +68,13 @@ class Trainer:
         nprocs = jax.process_count()
         # global batch divided per process (reference 2.distributed.py:113);
         # then further split per device by the mesh sharding.
-        if cfg.batch_size % (nprocs * max(1, self.mesh.devices.size // nprocs)) \
-                and cfg.batch_size % self.mesh.devices.size:
+        ndev = self.mesh.devices.size
+        # nprocs always divides ndev (equal local devices per process), so
+        # batch % ndev == 0 also guarantees an integral per-process batch
+        if cfg.batch_size % ndev:
             raise ValueError(
                 f"global batch {cfg.batch_size} not divisible by device count "
-                f"{self.mesh.devices.size}")
+                f"{ndev} ({nprocs} processes x {ndev // nprocs} local devices)")
         self.local_batch = cfg.batch_size // nprocs
 
         self.model = create_model(

@@ -7,7 +7,10 @@ XLA program: normalize/augment, forward, loss, grads, cross-replica reduction,
 optimizer update, and metric counts all fuse; there is no per-batch host
 round-trip and no barrier (XLA orders the collectives).
 
-Two interchangeable distribution flavors produce bit-comparable updates:
+Two interchangeable distribution flavors produce bit-comparable updates for
+BatchNorm-free models (for BN models the gradient math still agrees, but the
+running statistics differ by design — global-batch SyncBN vs per-replica +
+pmean, see below):
 
 * :func:`make_train_step` — *compiler-partitioned* (DDP-equivalent,
   reference variants 2/3/6): ``jit`` over a Mesh with the batch sharded on

@@ -64,7 +64,7 @@ class MoEMLP(nn.Module):
 
         onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (G, S, E)
         # position of each token in its expert's queue within the group
-        pos = jnp.cumsum(onehot, axis=1) * onehot - onehot
+        pos = (jnp.cumsum(onehot, axis=1) * onehot - onehot).astype(jnp.int32)
         keep = (pos < cap).astype(jnp.float32) * onehot
         # dispatch tensor (G, S, E, C): one-hot over capacity slots
         disp = keep[..., None] * jax.nn.one_hot(pos, cap, dtype=jnp.float32)

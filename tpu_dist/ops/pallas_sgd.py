@@ -48,7 +48,7 @@ def _fused_sgd_2d(p2, g2, m2, scalars, interpret: bool):
     rows = p2.shape[0]
     grid = (pl.cdiv(rows, BLOCK_ROWS),)
     bs = lambda: pl.BlockSpec((BLOCK_ROWS, LANE), lambda i: (i, 0),
-                              memory_space=pltpu.ANY if interpret else pltpu.VMEM)
+                              memory_space=pl.ANY if interpret else pltpu.VMEM)
     return pl.pallas_call(
         _sgd_kernel,
         grid=grid,

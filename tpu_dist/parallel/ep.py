@@ -43,24 +43,21 @@ def shard_state_ep(mesh: Mesh, state, axis: str = EXPERT_AXIS):
     of an MoE model's memory — leaving them replicated would defeat EP's
     scaling); everything else replicated.
 
-    Optimizer-state leaves don't carry the param path names, so expert leaves
-    are recognized by shape: any leaf whose shape matches an expert weight's.
+    Optimizer-state pytrees (e.g. optax trace) mirror the params dict, so the
+    expert leaves are identified by their tree PATH — a path ending in
+    w_in/w_out with a 3-D leaf — never by shape (two tensors can share a
+    shape without both being expert weights).
     """
-    from tpu_dist.engine.state import TrainState
+    from jax.tree_util import tree_map_with_path
 
-    expert_shapes = set()
-    def collect(tree, key=""):
-        if isinstance(tree, dict):
-            [collect(v, k) for k, v in tree.items()]
-        elif key in ("w_in", "w_out") and tree.ndim == 3:
-            expert_shapes.add(tree.shape)
-    collect(state.params)
+    from tpu_dist.engine.state import TrainState
 
     repl = NamedSharding(mesh, P())
     exp = lambda nd: NamedSharding(mesh, P(*([axis] + [None] * (nd - 1))))
 
-    def place(leaf):
-        if hasattr(leaf, "shape") and leaf.shape in expert_shapes:
+    def place(path, leaf):
+        names = {getattr(k, "key", getattr(k, "name", None)) for k in path}
+        if names & {"w_in", "w_out"} and getattr(leaf, "ndim", 0) == 3:
             return jax.device_put(leaf, exp(leaf.ndim))
         return jax.device_put(leaf, repl)
 
@@ -68,6 +65,6 @@ def shard_state_ep(mesh: Mesh, state, axis: str = EXPERT_AXIS):
         step=jax.device_put(state.step, repl),
         params=shard_moe_params(mesh, state.params, axis),
         batch_stats=jax.device_put(state.batch_stats, repl),
-        opt_state=jax.tree.map(place, state.opt_state),
+        opt_state=tree_map_with_path(place, state.opt_state),
         loss_scale=(None if state.loss_scale is None
                     else jax.device_put(state.loss_scale, repl)))
