@@ -4,8 +4,13 @@
 # (--profile-dir) — this script samples the TRAINING process's host RSS at the
 # same 500 ms cadence. Usage: statistics.sh <pid> [out.csv]; with no pid it
 # samples the newest python process running a scripts/*.py entrypoint.
-PID=${1:-$(pgrep -nf 'python.*scripts/.*\.py')}
-OUT=${2:-tpu_log.csv}
+# back-compat: `statistics.sh out.csv` (no pid) still works; with multiple
+# training processes (jax.distributed spawn) pass the rank-0 pid explicitly —
+# the pgrep fallback samples only the newest matching process.
+case "${1:-}" in
+  ''|*[!0-9]*) PID=$(pgrep -nf 'python.*scripts/.*\.py'); OUT=${1:-tpu_log.csv} ;;
+  *)           PID=$1; OUT=${2:-tpu_log.csv} ;;
+esac
 if [ -z "$PID" ] || [ ! -d "/proc/$PID" ]; then
   echo "statistics.sh: no training process found (pass a pid)" >&2
   exit 1

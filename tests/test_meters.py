@@ -3,34 +3,41 @@
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_dist.utils.meters import (AverageMeter, ProgressMeter, accuracy,
-                                   correct_counts, topk_accuracy)
+from tpu_dist.utils.meters import (MeterBank, accuracy, correct_counts,
+                                   topk_accuracy)
 
 
-def test_average_meter_running_avg():
-    m = AverageMeter("Loss", ":.4f")
-    m.update(2.0, n=2)
-    m.update(4.0, n=2)
-    assert m.val == 4.0
-    assert m.sum == 12.0
-    assert m.count == 4
-    assert m.avg == 3.0
+def test_meter_bank_weighted_running_avg():
+    b = MeterBank(10, [("Loss", ".4f")])
+    b.update("Loss", 2.0, n=2)
+    b.update("Loss", 4.0, n=2)
+    assert b.last("Loss") == 4.0
+    assert b.avg("Loss") == 3.0
 
 
-def test_average_meter_reset():
-    m = AverageMeter("x")
-    m.update(5.0)
-    m.reset()
-    assert m.avg == 0.0 and m.count == 0
+def test_meter_bank_empty_avg_is_zero():
+    b = MeterBank(10, [("Time", "6.3f")])
+    assert b.avg("Time") == 0.0
 
 
-def test_progress_meter_format():
-    m = AverageMeter("Loss", ":.2f")
-    m.update(1.5)
+def test_meter_bank_progress_line_format():
+    # cookbook-parity line: [i/N] header then "Name last (avg)" cells
+    b = MeterBank(100, [("Loss", ".2f")], prefix="Epoch: [3]")
+    b.update("Loss", 1.5)
     lines = []
-    p = ProgressMeter(100, [m], prefix="Epoch: [3]")
-    p.display(7, printer=lines.append)
+    b.display(7, printer=lines.append)
     assert lines == ["Epoch: [3][  7/100]\tLoss 1.50 (1.50)"]
+
+
+def test_meter_bank_avg_independent_of_update_batching():
+    # summing one window at a time must equal per-sample updates
+    a = MeterBank(10, [("x", ".2f")])
+    for v in (1.0, 2.0, 3.0, 6.0):
+        a.update("x", v)
+    window = MeterBank(10, [("x", ".2f")])
+    window.update("x", (1.0 + 2.0) / 2, n=2)
+    window.update("x", (3.0 + 6.0) / 2, n=2)
+    assert a.avg("x") == window.avg("x") == 3.0
 
 
 def test_simplified_accuracy_matches_reference_semantics():

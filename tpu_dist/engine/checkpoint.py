@@ -62,10 +62,13 @@ def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
         json.dump(meta, f)
     os.replace(path + ".json.tmp", path + ".json")
     if is_best:
-        # reference shutil.copyfile to 'model_best' (1.dataparallel.py:287-288)
-        shutil.copyfile(path, os.path.join(ckpt_dir, f"{arch}-model_best.msgpack"))
-        shutil.copyfile(path + ".json",
-                        os.path.join(ckpt_dir, f"{arch}-model_best.msgpack.json"))
+        # reference shutil.copyfile to 'model_best' (1.dataparallel.py:287-288),
+        # made atomic so a crash mid-copy can't destroy the previous best
+        for src, dst in ((path, f"{arch}-model_best.msgpack"),
+                         (path + ".json", f"{arch}-model_best.msgpack.json")):
+            best = os.path.join(ckpt_dir, dst)
+            shutil.copyfile(src, best + ".tmp")
+            os.replace(best + ".tmp", best)
     return path
 
 
@@ -78,7 +81,8 @@ def load_checkpoint(path: str, template_state) -> Tuple[Any, Dict]:
         off = len(_MAGIC)
         meta_len = int.from_bytes(raw[off:off + 8], "little")
         meta = json.loads(raw[off + 8:off + 8 + meta_len])
-        blob = raw[off + 8 + meta_len:]
+        # memoryview: don't hold a second full copy of a multi-GB state
+        blob = memoryview(raw)[off + 8 + meta_len:]
     else:  # pre-container checkpoint: bare msgpack + sidecar json
         blob = raw
         if os.path.exists(path + ".json"):

@@ -33,7 +33,7 @@ from tpu_dist.engine.steps import make_eval_step, make_shard_map_train_step, mak
 from tpu_dist.models import create_model
 from tpu_dist.ops import LossScaleState, make_optimizer, make_policy, step_decay_schedule
 from tpu_dist.parallel.mesh import batch_sharding, make_mesh, replicated
-from tpu_dist.utils.meters import AverageMeter, ProgressMeter
+from tpu_dist.utils.meters import MeterBank
 
 
 class Trainer:
@@ -175,13 +175,10 @@ class Trainer:
         cfg = self.cfg
         loader = self._loader(self.train_ds, True, epoch)
         nb = len(loader)
-        batch_time = AverageMeter("Time", ":6.3f")
-        data_time = AverageMeter("Data", ":6.3f")
-        losses = AverageMeter("Loss", ":.4e")
-        top1 = AverageMeter("Acc@1", ":6.3f")
-        top5 = AverageMeter("Acc@5", ":6.3f")
-        progress = ProgressMeter(nb, [batch_time, data_time, losses, top1, top5],
-                                 prefix=f"Epoch: [{epoch}]")
+        meters = MeterBank(nb, [("Time", "6.3f"), ("Data", "6.3f"),
+                                ("Loss", ".4e"), ("Acc@1", "6.3f"),
+                                ("Acc@5", "6.3f")],
+                           prefix=f"Epoch: [{epoch}]")
         skip = self._skip_batches
         self._skip_batches = 0
         pending = []
@@ -191,22 +188,27 @@ class Trainer:
             if i < skip:  # step-exact resume of a mid-epoch checkpoint
                 end = time.time()
                 continue
-            data_time.update(time.time() - end)
+            meters.update("Data", time.time() - end)
             self.state, metrics = self.train_step(
                 self.state, images, labels, self.rng)
             pending.append(metrics)
-            if i % cfg.print_freq == 0 or i == nb - 1:
+            boundary = i % cfg.print_freq == 0 or i == nb - 1
+            if boundary:
                 for m in jax.device_get(pending):
                     n = float(m["count"])
-                    losses.update(float(m["loss_sum"]) / n, int(n))
-                    top1.update(float(m["correct1"]) / n, int(n))
-                    top5.update(float(m["correct5"]) / n, int(n))
+                    meters.update("Loss", float(m["loss_sum"]) / n, int(n))
+                    meters.update("Acc@1", float(m["correct1"]) / n, int(n))
+                    meters.update("Acc@5", float(m["correct5"]) / n, int(n))
                 pending = []
-                batch_time.update(time.time() - end)
-                if self.is_main:
-                    progress.display(i)
+            # every iteration, so avg(Time) = wall/batches; under async
+            # dispatch the device wait lands on boundary iterations (the
+            # device_get above) and non-boundary Time is dispatch-only
+            meters.update("Time", time.time() - end)
+            if boundary and self.is_main:
+                meters.display(i)
             end = time.time()
-        return {"loss": losses.avg, "top1": top1.avg, "top5": top5.avg}
+        return {"loss": meters.avg("Loss"), "top1": meters.avg("Acc@1"),
+                "top5": meters.avg("Acc@5")}
 
     def validate(self, epoch: int = 0) -> float:
         """Distributed eval (C15): metric sums psum'd across replicas, padding

@@ -1,1 +1,1 @@
-from tpu_dist.utils.meters import AverageMeter, ProgressMeter, accuracy, topk_accuracy  # noqa: F401
+from tpu_dist.utils.meters import MeterBank, accuracy, topk_accuracy  # noqa: F401

@@ -1,8 +1,16 @@
 """Meters + accuracy (reference components C17/C18).
 
-The reference copies ``AverageMeter``/``ProgressMeter`` verbatim into every
-script (reference: 1.dataparallel.py:291-329 and five clones). Accuracy exists
-in two reference flavors:
+The reference carries a per-metric running-average object plus a separate
+progress printer, copied verbatim into every script (reference:
+1.dataparallel.py:291-329 and five clones). tpu_dist doesn't need that
+machinery: the loss/accuracy numbers are exact SUMS computed on device inside
+the jitted step and fetched in windows, so the host side only has to
+accumulate (sum, count, last) per metric name and render the cookbook's
+progress line — one :class:`MeterBank` per epoch does both. Only the printed
+line's field layout (``Name last (avg)`` cells after an ``[i/N]`` header)
+matches the reference, because that text IS the compatibility surface.
+
+Accuracy exists in two reference flavors:
 
 * a simplified top-1 (argmax == target fraction) returned twice as "top1/top5"
   (reference 1.dataparallel.py:339-364, documented in README_EN.md:654) — kept
@@ -23,50 +31,48 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-class AverageMeter:
-    """Running value/avg/sum/count meter (reference 1.dataparallel.py:291-312)."""
+class MeterBank:
+    """Named running sums for one epoch of host-side telemetry (C17).
 
-    def __init__(self, name: str, fmt: str = ":f"):
-        self.name = name
-        self.fmt = fmt
-        self.reset()
+    ``fields`` is an ordered ``(name, format_spec)`` sequence — the spec is a
+    plain Python format spec (e.g. ``".4e"``, ``"6.3f"``) applied to both the
+    last value and the running average in the progress line. Device metrics
+    are fed in at print-frequency boundaries as exact per-window sums; host
+    timings are fed every iteration, so every average is
+    total/size-weighted — there is no meter whose mean depends on how often
+    the loop prints.
+    """
 
-    def reset(self):
-        self.val = 0.0
-        self.avg = 0.0
-        self.sum = 0.0
-        self.count = 0
-
-    def update(self, val, n: int = 1):
-        val = float(val)
-        self.val = val
-        self.sum += val * n
-        self.count += n
-        self.avg = self.sum / max(self.count, 1)
-
-    def __str__(self):
-        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
-        return fmtstr.format(**self.__dict__)
-
-
-class ProgressMeter:
-    """Tab-joined progress line every N batches (reference 1.dataparallel.py:315-329)."""
-
-    def __init__(self, num_batches: int, meters, prefix: str = ""):
-        self.batch_fmtstr = self._get_batch_fmtstr(num_batches)
-        self.meters = meters
+    def __init__(self, total_batches: int, fields, prefix: str = ""):
+        self.total_batches = total_batches
         self.prefix = prefix
+        self._fields = list(fields)
+        # per name: [weighted sum, total weight, last value]
+        self._stats = {name: [0.0, 0, 0.0] for name, _ in self._fields}
 
-    def display(self, batch: int, printer=print):
-        entries = [self.prefix + self.batch_fmtstr.format(batch)]
-        entries += [str(meter) for meter in self.meters]
-        printer("\t".join(entries))
+    def update(self, name: str, value, n: int = 1) -> None:
+        s = self._stats[name]
+        v = float(value)
+        s[0] += v * n
+        s[1] += n
+        s[2] = v
 
-    @staticmethod
-    def _get_batch_fmtstr(num_batches: int) -> str:
-        num_digits = len(str(num_batches // 1))
-        fmt = "{:" + str(num_digits) + "d}"
-        return "[" + fmt + "/" + fmt.format(num_batches) + "]"
+    def avg(self, name: str) -> float:
+        s = self._stats[name]
+        return s[0] / max(s[1], 1)
+
+    def last(self, name: str) -> float:
+        return self._stats[name][2]
+
+    def line(self, batch: int) -> str:
+        w = len(str(self.total_batches))
+        cells = [f"{self.prefix}[{batch:{w}d}/{self.total_batches}]"]
+        cells += [f"{name} {self.last(name):{spec}} ({self.avg(name):{spec}})"
+                  for name, spec in self._fields]
+        return "\t".join(cells)
+
+    def display(self, batch: int, printer=print) -> None:
+        printer(self.line(batch))
 
 
 def accuracy(output, target):
