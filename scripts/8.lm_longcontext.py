@@ -54,6 +54,12 @@ def main():
                     help="MoE feed-forward with N experts (0 = dense); with "
                          "--mesh data=2,expert=4 experts shard over the "
                          "'expert' axis (GShard-style expert parallelism)")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="save checkpoints here (also on Ctrl-C); empty = off")
+    ap.add_argument("--save-freq", type=int, default=0,
+                    help="checkpoint every N steps (0 = only at end/interrupt)")
+    ap.add_argument("--resume", default="",
+                    help="checkpoint to resume from (continues at its step)")
     args = ap.parse_args()
 
     from tpu_dist.parallel import launch
@@ -63,6 +69,7 @@ def main():
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from tpu_dist.engine import checkpoint as ckpt
     from tpu_dist.engine.lm_steps import (make_lm_batches,
                                           make_lm_sp_train_step,
                                           make_lm_train_step)
@@ -105,28 +112,55 @@ def main():
         raise SystemExit("MoE + tensor parallelism not supported: the TP "
                          "rules don't shard 3-D expert weights — use "
                          "--mesh data=N,expert=M instead")
+    def place(st):
+        """Apply the mode's sharding; also re-places a resumed host state."""
+        if use_sp:
+            return jax.device_put(st, replicated(mesh))
+        if use_ep:
+            from tpu_dist.parallel.ep import shard_state_ep
+            return shard_state_ep(mesh, st)
+        if use_tp:
+            return TrainState(
+                step=jax.device_put(st.step, NamedSharding(mesh, P())),
+                params=shard_lm_params(mesh, st.params), batch_stats={},
+                opt_state=jax.device_put(st.opt_state,
+                                         NamedSharding(mesh, P())),
+                loss_scale=None)
+        if args.fsdp:
+            from tpu_dist.parallel.fsdp import shard_state_fsdp
+            return shard_state_fsdp(mesh, st)
+        return jax.device_put(st, replicated(mesh))
+
     if use_sp:
         step = make_lm_sp_train_step(partial(tiny_lm, **lm_kw), tx, mesh)
         data_spec = P("data", "seq")
-        state = jax.device_put(state, replicated(mesh))
     else:
         step = make_lm_train_step(model, tx, mesh)
         data_spec = P("data")
-        if use_ep:
-            from tpu_dist.parallel.ep import shard_state_ep
-            state = shard_state_ep(mesh, state)
-        elif use_tp:
-            state = TrainState(
-                step=jax.device_put(state.step, NamedSharding(mesh, P())),
-                params=shard_lm_params(mesh, state.params), batch_stats={},
-                opt_state=jax.device_put(state.opt_state,
-                                         NamedSharding(mesh, P())),
-                loss_scale=None)
-        elif args.fsdp:
-            from tpu_dist.parallel.fsdp import shard_state_fsdp
-            state = shard_state_fsdp(mesh, state)
-        else:
-            state = jax.device_put(state, replicated(mesh))
+
+    # model geometry stamped into every checkpoint; a mismatched resume must
+    # fail with a clear message, not a deep XLA shape error
+    geometry = {"vocab_size": args.vocab_size, "num_layers": args.num_layers,
+                "d_model": args.d_model, "num_heads": args.num_heads,
+                "seq_len": args.seq_len, "num_experts": args.num_experts}
+
+    start_step = 0
+    if args.resume:
+        # load into the freshly-initialized (host) template, THEN shard —
+        # works for every mode because placement is orthogonal to the blob
+        state, meta = ckpt.load_checkpoint(args.resume, state)
+        bad = {k: (meta[k], v) for k, v in geometry.items()
+               if k in meta and meta[k] != v}
+        if bad:
+            raise SystemExit(
+                "--resume checkpoint has different model geometry: " +
+                ", ".join(f"{k}: checkpoint {a} vs flags {b}"
+                          for k, (a, b) in bad.items()))
+        start_step = int(np.asarray(state.step))
+        if jax.process_index() == 0:
+            print(f"=> resumed from {args.resume} (step {start_step})",
+                  flush=True)
+    state = place(state)
 
     # synthetic affine-rule token stream (learnable, deterministic)
     rng = np.random.default_rng(0)
@@ -150,19 +184,47 @@ def main():
     if jax.process_index() == 0:
         print(f"[proc {info.process_id}/{info.num_processes}] mesh={dict(mesh.shape)} "
               f"mode={mode} tokens/step={args.batch_size * args.seq_len}")
+    last_saved = [-1]
+
+    def save(st, step_no):
+        if not args.checkpoint_dir or step_no == last_saved[0]:
+            return  # off, or this exact step already on disk
+        # gathers cross-host shards inside (collective) — every process calls
+        ckpt.save_checkpoint(args.checkpoint_dir, st, 0, 0.0, "lm",
+                             is_best=False,
+                             extra_meta={"mode": mode, **geometry})
+        last_saved[0] = step_no
+
     key = jax.random.PRNGKey(1)
+    i = start_step
     t0 = time.perf_counter()
-    for i in range(args.steps):
-        state, metrics = step(state, inputs, targets, key)
-        if i % args.print_freq == 0 or i == args.steps - 1:
-            m = jax.device_get(metrics)
-            loss = float(m["loss_sum"]) / float(m["count"])
-            acc = float(m["correct1"]) / float(m["count"])
-            if jax.process_index() == 0:
-                print(f"step {i:4d} loss {loss:.4f} acc {acc:.3f}")
+    try:
+        for i in range(start_step, args.steps):
+            state, metrics = step(state, inputs, targets, key)
+            if i % args.print_freq == 0 or i == args.steps - 1:
+                m = jax.device_get(metrics)
+                loss = float(m["loss_sum"]) / float(m["count"])
+                acc = float(m["correct1"]) / float(m["count"])
+                if jax.process_index() == 0:
+                    print(f"step {i:4d} loss {loss:.4f} acc {acc:.3f}")
+            if args.save_freq and (i + 1) % args.save_freq == 0:
+                save(state, i + 1)
+    except KeyboardInterrupt:
+        # best-effort on multi-host sharded state: peers interrupted at a
+        # different step would desync the collective gather — single-host
+        # (the normal Ctrl-C case) is always safe
+        save(state, i + 1)
+        if jax.process_index() == 0:
+            print(("interrupted — checkpoint saved at step "
+                   f"{int(np.asarray(jax.device_get(state.step)))}; "
+                   "resume with --resume") if args.checkpoint_dir else
+                  "interrupted — no --checkpoint-dir, nothing saved",
+                  flush=True)
+        raise
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
-    toks = args.steps * args.batch_size * args.seq_len
+    save(state, args.steps)
+    toks = (args.steps - start_step) * args.batch_size * args.seq_len
     if jax.process_index() == 0:
         print(f"throughput {toks / dt:,.0f} tokens/sec ({mode})")
 

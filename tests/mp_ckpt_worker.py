@@ -1,0 +1,76 @@
+"""Worker for the multi-process SHARDED-checkpoint test (test_multiprocess).
+
+2 processes x 2 local devices, FSDP state sharded over the 4-device global
+mesh — every sizeable leaf is NOT fully addressable from either process, so
+save_checkpoint must take its collective process_allgather path (the case
+round-1 checkpointing would have crashed on). Process 0 then restores the
+blob and checks it equals the pre-shard host state.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    out = os.environ["TPU_DIST_TEST_OUT"]
+    local_devices = int(os.environ.get("TPU_DIST_LOCAL_DEVICES", "2"))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", local_devices)
+
+    from tpu_dist.parallel import launch
+
+    launch.initialize()
+    assert jax.process_count() == int(os.environ["TPU_DIST_EXPECT_PROCS"])
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist.engine import checkpoint as ckpt
+    from tpu_dist.engine.state import TrainState
+    from tpu_dist.models.transformer import tiny_lm
+    from tpu_dist.ops import make_optimizer
+    from tpu_dist.parallel.fsdp import shard_state_fsdp
+    from tpu_dist.parallel.mesh import make_mesh
+
+    lm = tiny_lm(vocab_size=64, num_layers=2, d_model=64, num_heads=4,
+                 max_len=32)
+    params = lm.init({"params": jax.random.PRNGKey(0)},
+                     jnp.zeros((1, 32), jnp.int32), train=False)["params"]
+    tx = make_optimizer(0.01, 0.9, 0.0, steps_per_epoch=10)
+    ref = TrainState.create(params, {}, tx)
+    ref_host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), ref)
+
+    mesh = make_mesh((jax.device_count(),), ("data",))
+    sharded = shard_state_fsdp(mesh, ref, min_size=256)
+    n_nonaddr = sum(not leaf.is_fully_addressable
+                    for leaf in jax.tree.leaves(sharded.params))
+    assert n_nonaddr > 0, "test must cover the non-addressable gather path"
+
+    path = ckpt.save_checkpoint(out, sharded, epoch=1, best_acc1=0.0,
+                                arch="lm", is_best=False)
+
+    if jax.process_index() == 0:
+        template = TrainState.create(params, {}, tx)
+        restored, meta = ckpt.load_checkpoint(path, template)
+        mismatches = sum(
+            not np.array_equal(np.asarray(a), np.asarray(jax.device_get(b)))
+            for a, b in zip(jax.tree.leaves(ref_host.params),
+                            jax.tree.leaves(restored.params)))
+        mismatches += sum(
+            not np.array_equal(np.asarray(a), np.asarray(jax.device_get(b)))
+            for a, b in zip(jax.tree.leaves(ref_host.opt_state),
+                            jax.tree.leaves(restored.opt_state)))
+        with open(os.path.join(out, "ckpt_result.json"), "w") as f:
+            json.dump({"ok": mismatches == 0, "mismatches": mismatches,
+                       "nonaddressable_leaves": n_nonaddr,
+                       "meta_epoch": meta.get("epoch")}, f)
+
+
+if __name__ == "__main__":
+    main()

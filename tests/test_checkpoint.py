@@ -76,6 +76,91 @@ def test_interrupt_saves_resumable_checkpoint(tmp_path, monkeypatch):
                                        "lenet-checkpoint.msgpack"))
 
 
+def _lm_state():
+    import jax.numpy as jnp
+    from tpu_dist.models.transformer import tiny_lm
+
+    lm = tiny_lm(vocab_size=64, num_layers=2, d_model=64, num_heads=4,
+                 max_len=32)
+    params = lm.init({"params": jax.random.PRNGKey(0)},
+                     jnp.zeros((1, 32), jnp.int32), train=False)["params"]
+    tx = make_optimizer(0.01, 0.9, 0.0, steps_per_epoch=10)
+    return TrainState.create(params, {}, tx)
+
+
+def _assert_states_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb) > 0
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(x)),
+                                      np.asarray(jax.device_get(y)))
+
+
+def test_fsdp_sharded_state_roundtrip(tmp_path):
+    """ZeRO-3-placed TrainState saves as the full global state and restores."""
+    from tpu_dist.parallel.fsdp import shard_state_fsdp
+    from tpu_dist.parallel.mesh import make_mesh
+
+    mesh = make_mesh((8,), ("data",))
+    ref = _lm_state()
+    sharded = shard_state_fsdp(mesh, ref, min_size=256)
+    path = ckpt.save_checkpoint(str(tmp_path), sharded, epoch=1, best_acc1=0.0,
+                                arch="lm", is_best=False)
+    restored, _ = ckpt.load_checkpoint(path, _lm_state())
+    _assert_states_equal(ref.params, restored.params)
+    _assert_states_equal(ref.opt_state, restored.opt_state)
+    # and the restored host state re-places cleanly
+    shard_state_fsdp(mesh, restored, min_size=256)
+
+
+def test_tp_sharded_state_roundtrip(tmp_path):
+    """Megatron-sharded params save as the full global state and restore."""
+    from tpu_dist.parallel.mesh import make_mesh
+    from tpu_dist.parallel.tp import shard_lm_params
+
+    mesh = make_mesh((4, 2), ("data", "model"))
+    ref = _lm_state()
+    sharded = TrainState(step=ref.step,
+                         params=shard_lm_params(mesh, ref.params),
+                         batch_stats={}, opt_state=ref.opt_state,
+                         loss_scale=None)
+    path = ckpt.save_checkpoint(str(tmp_path), sharded, epoch=1, best_acc1=0.0,
+                                arch="lm", is_best=False)
+    restored, _ = ckpt.load_checkpoint(path, _lm_state())
+    _assert_states_equal(ref.params, restored.params)
+
+
+def test_mid_epoch_resume_rejects_changed_geometry(tmp_path):
+    """A mid-epoch checkpoint + different --batch-size must fail loudly, not
+    silently double-apply/skip batches (ADVICE r1 medium)."""
+    import pytest
+    from tpu_dist.configs import TrainConfig
+    from tpu_dist.engine import Trainer
+
+    kw = dict(dataset="synthetic-mnist", arch="lenet", epochs=1,
+              synth_train_size=256, synth_val_size=64, seed=3, print_freq=100,
+              checkpoint_dir=str(tmp_path))
+    tr = Trainer(TrainConfig(batch_size=64, **kw))
+    real_step = tr.train_step
+
+    def limited(*a, **k):
+        if limited.n == 2:
+            raise KeyboardInterrupt
+        limited.n += 1
+        return real_step(*a, **k)
+
+    limited.n = 0
+    tr.train_step = limited
+    with pytest.raises(KeyboardInterrupt):
+        tr.fit()
+    ck = os.path.join(str(tmp_path), "lenet-checkpoint.msgpack")
+    with pytest.raises(ValueError, match="geometry"):
+        Trainer(TrainConfig(batch_size=32, resume=ck, **kw))
+    # same geometry still resumes fine
+    assert Trainer(TrainConfig(batch_size=64, resume=ck,
+                               **kw))._skip_batches == 2
+
+
 def test_mid_epoch_resume_is_step_exact(tmp_path):
     """Interrupt mid-epoch, resume -> final params == uninterrupted run."""
     import pytest

@@ -45,7 +45,7 @@ def _worker_env(outdir: str, nprocs: int, local_devices: int) -> dict:
 
 
 def run_workers(tmp, tag: str, nprocs: int, local_devices: int,
-                timeout: int = 420) -> str:
+                timeout: int = 420, worker: str = WORKER) -> str:
     outdir = os.path.join(tmp, tag)
     os.makedirs(outdir, exist_ok=True)
     base = _worker_env(outdir, nprocs, local_devices)
@@ -59,7 +59,7 @@ def run_workers(tmp, tag: str, nprocs: int, local_devices: int,
                        TPU_DIST_PROCESS_ID=str(rank))
         log = open(os.path.join(outdir, f"worker-{rank}.log"), "w")
         procs.append((rank, log, subprocess.Popen(
-            [sys.executable, WORKER], env=env, cwd=ROOT,
+            [sys.executable, worker], env=env, cwd=ROOT,
             stdout=log, stderr=subprocess.STDOUT)))
     failed = []
     for rank, log, p in procs:
@@ -113,3 +113,16 @@ def test_multiprocess_metrics_match(runs):
     (res1, _), (res2, _) = runs
     # distributed eval (psum'd metric sums, padding masked) must agree too
     assert res1["best_acc1"] == pytest.approx(res2["best_acc1"], abs=1e-3)
+
+
+def test_multiprocess_sharded_checkpoint(tmp_path):
+    """FSDP leaves sharded ACROSS processes (non-addressable) save and
+    restore bit-exactly — the collective process_allgather path."""
+    worker = os.path.join(ROOT, "tests", "mp_ckpt_worker.py")
+    outdir = run_workers(str(tmp_path), "ckpt", nprocs=2, local_devices=2,
+                         worker=worker)
+    with open(os.path.join(outdir, "ckpt_result.json")) as f:
+        res = json.load(f)
+    assert res["nonaddressable_leaves"] > 0
+    assert res["meta_epoch"] == 1
+    assert res["ok"], res

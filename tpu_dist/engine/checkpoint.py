@@ -28,7 +28,26 @@ from flax import serialization
 
 
 def _to_host(tree):
-    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    """Host numpy copy of every leaf, reassembling sharded global arrays.
+
+    Replicated leaves — even over a multi-host mesh — read out locally via
+    device_get (jax materializes fully-replicated arrays from the local
+    replica). Only leaves that are BOTH non-addressable and non-replicated
+    (multi-host FSDP/TP/EP shards) need ``process_allgather`` — a COLLECTIVE
+    over processes, so every process must reach this call for such states
+    (save_checkpoint gathers before its process-0 gate for exactly this
+    reason). Fully-replicated states therefore never enter a collective and
+    process 0 can save them single-sidedly (e.g. from an interrupt handler).
+    """
+    from jax.experimental import multihost_utils
+
+    def get(x):
+        if (isinstance(x, jax.Array) and not x.is_fully_addressable
+                and not x.is_fully_replicated):
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(get, tree)
 
 
 # single-file container so blob+meta commit in ONE os.replace (a two-file
@@ -40,15 +59,25 @@ _MAGIC = b"TPUDIST1\n"
 def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
                     arch: str, is_best: bool,
                     extra_meta: Optional[Dict] = None) -> Optional[str]:
-    """Process-0 atomic save; returns path (None on non-zero processes)."""
+    """Atomic save; returns path on process 0, None elsewhere.
+
+    For states with cross-host SHARDED leaves, ALL processes must call this
+    (the gather is collective); replicated states save process-0-only.
+    """
+    needs_collective = any(
+        isinstance(x, jax.Array) and not x.is_fully_addressable
+        and not x.is_fully_replicated for x in jax.tree.leaves(state))
+    if jax.process_index() != 0 and not needs_collective:
+        return None  # replicated state: no reason to host-copy it everywhere
+    host_state = _to_host(state)  # collective only for cross-host shards
     if jax.process_index() != 0:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
     path = os.path.join(ckpt_dir, f"{arch}-checkpoint.msgpack")
     meta = {"epoch": epoch, "arch": arch, "best_acc1": float(best_acc1),
-            "step": int(jax.device_get(state.step)), **(extra_meta or {})}
+            "step": int(host_state.step), **(extra_meta or {})}
     meta_bytes = json.dumps(meta).encode()
-    blob = serialization.to_bytes(_to_host(state))
+    blob = serialization.to_bytes(host_state)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(_MAGIC)

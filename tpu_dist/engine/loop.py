@@ -131,6 +131,12 @@ class Trainer:
         self.start_epoch = cfg.start_epoch
         self._skip_batches = 0
         self.is_main = jax.process_index() == 0
+        # geometry stamped into every checkpoint: resume math (step ->
+        # epoch/skip mapping, LR schedule) is only valid against the same
+        # steps_per_epoch, so mismatches must not pass silently
+        self._run_meta = {"steps_per_epoch": self.steps_per_epoch,
+                          "batch_size": cfg.batch_size,
+                          "dataset_len": len(self.train_ds)}
 
         if cfg.resume:
             self.state, meta = ckpt.load_checkpoint(cfg.resume, state)
@@ -138,6 +144,19 @@ class Trainer:
             self.start_epoch = meta.get("epoch", 0)
             self.best_acc1 = meta.get("best_acc1", 0.0)
             self.log(f"=> resumed from {cfg.resume} (epoch {self.start_epoch})")
+            mismatch = {k: (meta[k], v) for k, v in self._run_meta.items()
+                        if k in meta and meta[k] != v}
+            if mismatch:
+                detail = ", ".join(f"{k}: checkpoint {a} vs run {b}"
+                                   for k, (a, b) in mismatch.items())
+                if meta.get("mid_epoch"):
+                    # the skip count below would misplace the resume point:
+                    # double-applied or skipped batches + LR-schedule drift
+                    raise ValueError(
+                        "mid-epoch resume requires the checkpoint's data/"
+                        f"batch geometry ({detail})")
+                self.log(f"warning: resume with changed geometry ({detail}); "
+                         "the LR schedule will not line up with the original run")
             # mid-epoch (interrupt) checkpoint: the sampler's per-epoch
             # permutation is deterministic, so resume is STEP-exact — derive
             # the true epoch from the step counter and skip the batches whose
@@ -254,7 +273,8 @@ class Trainer:
             ckpt.save_checkpoint(cfg.checkpoint_dir, self.state,
                                  self._epoch_in_progress, self.best_acc1,
                                  cfg.arch, is_best=False,
-                                 extra_meta={"mid_epoch": True})
+                                 extra_meta={"mid_epoch": True,
+                                             **self._run_meta})
             self.log(f"interrupted — checkpoint saved at epoch "
                      f"{self._epoch_in_progress}; resume with --resume")
             raise
@@ -281,7 +301,8 @@ class Trainer:
                 with open(csv_path, "a+", newline="") as f:
                     csv.writer(f).writerow([t0, epoch_secs])
             ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, epoch + 1,
-                                 self.best_acc1, cfg.arch, is_best)
+                                 self.best_acc1, cfg.arch, is_best,
+                                 extra_meta=self._run_meta)
             self.log(f"Epoch {epoch}: train_loss={train_metrics['loss']:.4f} "
                      f"val_acc1={acc1 * 100:.3f} best={self.best_acc1 * 100:.3f} "
                      f"({epoch_secs:.1f}s)")
