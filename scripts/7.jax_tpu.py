@@ -19,7 +19,7 @@ from tpu_dist.parallel import launch
 
 DEFAULTS = TrainConfig(arch="resnet50", epochs=10, batch_size=1024,
                        dataset="cifar10", variant="jit", precision="bf16",
-                       log_csv="jax_tpu.csv")
+                       steps_per_dispatch=16, log_csv="jax_tpu.csv")
 
 if __name__ == "__main__":
     cfg = parse_config(defaults=DEFAULTS, description=__doc__)

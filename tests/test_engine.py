@@ -235,3 +235,118 @@ def test_grad_accum_equals_big_batch():
     fb = np.concatenate([np.asarray(x).ravel()
                          for x in jax.tree.leaves(s_acc.params)])
     np.testing.assert_allclose(fa, fb, rtol=1e-5, atol=1e-7)
+
+
+def test_indexed_multi_step_equals_host_batches():
+    """Device-resident dataset + (K,B) index window == host-fed batches."""
+    from tpu_dist.engine.steps import (make_indexed_multi_train_step,
+                                       pack_images_for_device)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh()
+    model = _MLP()
+    params, stats = init_model(model, jax.random.PRNGKey(0), (2, 28, 28, 1))
+    tx = make_optimizer(0.1, 0.9, 1e-4, steps_per_epoch=1000)
+    state0 = jax.device_put(TrainState.create(params, stats, tx),
+                            replicated(mesh))
+    transform = make_transform(np.full((1,), 0.5, np.float32),
+                               np.full((1,), 0.25, np.float32))
+    single = make_train_step(model, tx, transform, mesh, donate=False)
+    indexed = make_indexed_multi_train_step(model, tx, transform, mesh,
+                                            (28, 28, 1), donate=False)
+
+    n, k, b = 256, 3, 32
+    rng_np = np.random.default_rng(1)
+    images_all = rng_np.integers(0, 255, (n, 28, 28, 1)).astype(np.uint8)
+    labels_all = rng_np.integers(0, 10, (n,)).astype(np.int32)
+    idx = rng_np.integers(0, n, (k, b)).astype(np.int32)
+    key = jax.random.PRNGKey(7)
+
+    sh = batch_sharding(mesh)
+    s_seq = state0
+    for i in range(k):
+        s_seq, _ = single(s_seq, jax.device_put(images_all[idx[i]], sh),
+                          jax.device_put(labels_all[idx[i]], sh), key)
+
+    packed = pack_images_for_device(images_all)
+    assert packed.dtype == np.int32  # 28*28*1 is word-divisible -> packed path
+    repl = replicated(mesh)
+    s_idx, m = indexed(state0, jax.device_put(packed, repl),
+                       jax.device_put(labels_all, repl),
+                       jax.device_put(idx, NamedSharding(mesh, P(None, "data"))),
+                       key)
+    assert float(jax.device_get(m["count"])) == k * b
+    fa = np.concatenate([np.asarray(x).ravel()
+                         for x in jax.tree.leaves(s_seq.params)])
+    fb = np.concatenate([np.asarray(x).ravel()
+                         for x in jax.tree.leaves(s_idx.params)])
+    np.testing.assert_allclose(fa, fb, rtol=1e-5, atol=1e-7)
+    assert int(jax.device_get(s_idx.step)) == k
+
+
+def _trainer_params(tmp, k, placement="auto", epochs=1):
+    from tpu_dist.configs import TrainConfig
+    from tpu_dist.engine import Trainer
+
+    cfg = TrainConfig(dataset="synthetic-mnist", arch="lenet", epochs=epochs,
+                      batch_size=64, synth_train_size=320, synth_val_size=64,
+                      seed=11, print_freq=100, checkpoint_dir=tmp,
+                      steps_per_dispatch=k, data_placement=placement)
+    tr = Trainer(cfg)
+    tr.fit()
+    return tr, np.concatenate([np.asarray(jax.device_get(x)).ravel()
+                               for x in jax.tree.leaves(tr.state.params)])
+
+
+def test_trainer_windowed_device_data_matches_per_batch(tmp_path):
+    """steps_per_dispatch=4 + HBM-resident dataset == the per-batch loop."""
+    tr1, p1 = _trainer_params(str(tmp_path / "a"), k=1)
+    tr4, p4 = _trainer_params(str(tmp_path / "b"), k=4)
+    assert tr1.device_data is False and tr4.device_data is True
+    assert (int(jax.device_get(tr1.state.step))
+            == int(jax.device_get(tr4.state.step)) == 5)  # ceil(320/64)
+    np.testing.assert_allclose(p1, p4, rtol=1e-5, atol=1e-7)
+
+
+def test_trainer_windowed_host_mode_matches_per_batch(tmp_path):
+    """steps_per_dispatch=2 with host-stacked windows (tail window of 1)."""
+    _, p1 = _trainer_params(str(tmp_path / "a"), k=1)
+    tr2, p2 = _trainer_params(str(tmp_path / "b"), k=2, placement="host")
+    assert tr2.device_data is False
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-7)
+
+
+def test_trainer_windowed_mid_epoch_resume_step_exact(tmp_path):
+    """Interrupt between windows, resume -> same params as uninterrupted."""
+    import os
+    import pytest
+    from tpu_dist.configs import TrainConfig
+    from tpu_dist.engine import Trainer
+
+    kw = dict(dataset="synthetic-mnist", arch="lenet", epochs=1,
+              batch_size=64, synth_train_size=320, synth_val_size=64,
+              seed=11, print_freq=100, steps_per_dispatch=2)
+    _, p_full = _trainer_params(str(tmp_path / "full"), k=2)
+
+    tr_int = Trainer(TrainConfig(checkpoint_dir=str(tmp_path / "int"), **kw))
+    real = tr_int.window_step
+    calls = {"n": 0}
+
+    def limited(*a, **kws):
+        if calls["n"] == 2:  # after 2 windows = 4 of 5 batches
+            raise KeyboardInterrupt
+        calls["n"] += 1
+        return real(*a, **kws)
+
+    tr_int.window_step = limited
+    with pytest.raises(KeyboardInterrupt):
+        tr_int.fit()
+
+    ck = os.path.join(str(tmp_path / "int"), "lenet-checkpoint.msgpack")
+    tr_res = Trainer(TrainConfig(checkpoint_dir=str(tmp_path / "res"),
+                                 resume=ck, **kw))
+    assert tr_res._skip_batches == 4
+    tr_res.fit()
+    p_res = np.concatenate([np.asarray(jax.device_get(x)).ravel()
+                            for x in jax.tree.leaves(tr_res.state.params)])
+    np.testing.assert_allclose(p_full, p_res, rtol=1e-5, atol=1e-7)

@@ -67,6 +67,17 @@ class TrainConfig:
     adasum: bool = False                        # reference 5.2...py:184 (mapped to
                                                 # plain mean on TPU; doc'd delta)
 
+    # -- dispatch/data-path tuning (TPU-only; no reference analog — its
+    #    per-batch host loop was the bottleneck the prefetcher fought, C13)
+    steps_per_dispatch: int = 1        # K optimizer steps per XLA dispatch
+                                       # (lax.scan window; amortizes controller
+                                       # latency — requires variant 'jit')
+    data_placement: str = "auto"       # host | device | auto: 'device' keeps
+                                       # the whole uint8 dataset in HBM and
+                                       # sends only index windows per step
+                                       # (auto: device when in-memory and
+                                       # steps_per_dispatch > 1)
+
     # -- observability (reference C21/C22)
     log_csv: str = ""                  # per-epoch [start, seconds] CSV if set
     profile_dir: str = ""              # jax.profiler trace dir if set

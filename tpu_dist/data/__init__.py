@@ -1,4 +1,5 @@
 from tpu_dist.data.datasets import ArrayDataset, load_dataset  # noqa: F401
-from tpu_dist.data.loader import DataLoader, prefetch_to_device  # noqa: F401
+from tpu_dist.data.loader import (DataLoader, assemble_global,  # noqa: F401
+                                  prefetch_to_device)
 from tpu_dist.data.pipeline import make_transform  # noqa: F401
 from tpu_dist.data.sampler import DistributedSampler  # noqa: F401

@@ -91,6 +91,23 @@ class DataLoader:
             stop.set()
 
 
+def assemble_global(sharding, batch):
+    """Device-put a host batch (array or tuple of arrays) onto ``sharding``.
+
+    THE one place that knows the multi-controller rule: when >1 process
+    feeds, each holds only its own sampler shard, so the global array must be
+    assembled with ``jax.make_array_from_process_local_data`` — a bare
+    device_put would treat the local shard as the whole global array and
+    silently drop the other processes' data.
+    """
+    if jax.process_count() > 1:
+        if isinstance(batch, tuple):
+            return tuple(jax.make_array_from_process_local_data(sharding, a)
+                         for a in batch)
+        return jax.make_array_from_process_local_data(sharding, batch)
+    return jax.device_put(batch, sharding)
+
+
 def prefetch_to_device(iterator, sharding=None, size: int = 2):
     """Keep ``size`` device-put batches in flight (C13 equivalent, stream-free).
 
@@ -103,16 +120,11 @@ def prefetch_to_device(iterator, sharding=None, size: int = 2):
     processes' data — the multi-controller JAX pitfall).
     """
     buf = []
-    multiprocess = jax.process_count() > 1
 
     def put(batch):
         if sharding is None:
             return jax.tree.map(jax.device_put, batch)
-        if multiprocess:
-            return tuple(
-                jax.make_array_from_process_local_data(sharding, arr)
-                for arr in batch)
-        return jax.device_put(batch, sharding)
+        return assemble_global(sharding, batch)
     it = iter(iterator)
     try:
         for _ in range(size):

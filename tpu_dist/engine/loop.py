@@ -19,17 +19,21 @@ from __future__ import annotations
 import csv
 import os
 import time
+from functools import partial
 from typing import Callable, Dict, Optional
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpu_dist import configs
-from tpu_dist.data import (DataLoader, DistributedSampler, load_dataset,
-                           make_transform, prefetch_to_device)
+from tpu_dist.data import (DataLoader, DistributedSampler, assemble_global,
+                           load_dataset, make_transform, prefetch_to_device)
 from tpu_dist.engine import checkpoint as ckpt
 from tpu_dist.engine.state import TrainState, init_model
-from tpu_dist.engine.steps import make_eval_step, make_shard_map_train_step, make_train_step
+from tpu_dist.engine.steps import (make_eval_step, make_indexed_multi_train_step,
+                                   make_multi_train_step,
+                                   make_shard_map_train_step, make_train_step)
 from tpu_dist.models import create_model
 from tpu_dist.ops import LossScaleState, make_optimizer, make_policy, step_decay_schedule
 from tpu_dist.parallel.mesh import batch_sharding, make_mesh, replicated
@@ -126,6 +130,47 @@ class Trainer:
                 self.model, self.tx, self.transform, self.mesh)
         self.eval_step = make_eval_step(self.model, eval_transform, self.mesh)
 
+        # K-steps-per-dispatch window (VERDICT r1 #3: the bench's multi-step
+        # machinery wired into real training). Math is identical to K
+        # sequential dispatches; only the host round-trip count changes.
+        self.k = cfg.steps_per_dispatch
+        if self.k < 1:
+            raise ValueError("steps_per_dispatch must be >= 1")
+        if self.k > 1 and cfg.variant != "jit":
+            raise ValueError("steps_per_dispatch > 1 requires variant='jit'")
+        if cfg.data_placement not in ("auto", "host", "device"):
+            raise ValueError(f"unknown data_placement {cfg.data_placement!r}")
+        in_memory = isinstance(getattr(self.train_ds, "images", None), np.ndarray)
+        if cfg.data_placement == "device" and not in_memory:
+            raise ValueError("data_placement='device' needs an in-memory "
+                             "(ArrayDataset) training set")
+        if cfg.data_placement == "device" and cfg.variant != "jit":
+            # the indexed window step is compiler-partitioned; routing a
+            # shard_map config through it would silently drop grad
+            # compression/predivide and per-replica BN semantics
+            raise ValueError("data_placement='device' requires variant='jit'")
+        self.device_data = (cfg.data_placement == "device" or
+                            (cfg.data_placement == "auto" and in_memory
+                             and self.k > 1))
+        self._train_data_dev = None
+        self._prefetched_windows = None  # (epoch, [(n, device idx window)])
+        if self.device_data:
+            # whole training set resident in HBM (rows packed into i32 words
+            # for native 32-bit gathers), replicated per chip; per-step
+            # batches are gathered on device from an index window
+            from tpu_dist.engine.steps import pack_images_for_device
+            self._train_data_dev = (
+                jax.device_put(pack_images_for_device(self.train_ds.images),
+                               replicated(self.mesh)),
+                jax.device_put(self.train_ds.labels.astype(np.int32),
+                               replicated(self.mesh)))
+            self.window_step = make_indexed_multi_train_step(
+                self.model, self.tx, self.transform, self.mesh,
+                self.train_ds.image_shape)
+        elif self.k > 1:
+            self.window_step = make_multi_train_step(
+                self.model, self.tx, self.transform, self.mesh)
+
         self.batch_sharding = batch_sharding(self.mesh)
         self.best_acc1 = 0.0
         self.start_epoch = cfg.start_epoch
@@ -133,10 +178,16 @@ class Trainer:
         self.is_main = jax.process_index() == 0
         # geometry stamped into every checkpoint: resume math (step ->
         # epoch/skip mapping, LR schedule) is only valid against the same
-        # steps_per_epoch, so mismatches must not pass silently
+        # steps_per_epoch, and the blob only loads correctly into the same
+        # model/dataset shapes (flax from_bytes does NOT validate them) —
+        # mismatches must not pass silently
         self._run_meta = {"steps_per_epoch": self.steps_per_epoch,
                           "batch_size": cfg.batch_size,
-                          "dataset_len": len(self.train_ds)}
+                          "dataset_len": len(self.train_ds),
+                          "arch": cfg.arch,
+                          "dataset": self.train_ds.name,
+                          "num_classes": self.num_classes,
+                          "image_shape": list(self.train_ds.image_shape)}
 
         if cfg.resume:
             self.state, meta = ckpt.load_checkpoint(cfg.resume, state)
@@ -146,9 +197,17 @@ class Trainer:
             self.log(f"=> resumed from {cfg.resume} (epoch {self.start_epoch})")
             mismatch = {k: (meta[k], v) for k, v in self._run_meta.items()
                         if k in meta and meta[k] != v}
+            detail = ", ".join(f"{k}: checkpoint {a} vs run {b}"
+                               for k, (a, b) in mismatch.items())
+            # model/input identity: the blob would load into wrong-shaped
+            # arrays without any error from flax (or train a wrong-width
+            # head) — always fatal
+            hard = {"arch", "num_classes", "image_shape"} & mismatch.keys()
+            if hard:
+                raise ValueError(
+                    f"--resume checkpoint is from a different model geometry "
+                    f"({detail})")
             if mismatch:
-                detail = ", ".join(f"{k}: checkpoint {a} vs run {b}"
-                                   for k, (a, b) in mismatch.items())
                 if meta.get("mid_epoch"):
                     # the skip count below would misplace the resume point:
                     # double-applied or skipped batches + LR-schedule drift
@@ -179,18 +238,23 @@ class Trainer:
         if self.is_main:
             print(*a, **k, flush=True)
 
-    def _loader(self, ds, train: bool, epoch: int) -> DataLoader:
-        nprocs = jax.process_count()
+    def _sampler(self, ds, train: bool, epoch: int) -> DistributedSampler:
         sampler = DistributedSampler(
-            len(ds), num_replicas=nprocs, rank=jax.process_index(),
-            shuffle=train, seed=(self.cfg.seed or 0) + (17 if not train else 0),
+            len(ds), num_replicas=jax.process_count(),
+            rank=jax.process_index(), shuffle=train,
+            seed=(self.cfg.seed or 0) + (17 if not train else 0),
             batch_size=self.local_batch)
         sampler.set_epoch(epoch)
-        return DataLoader(ds, sampler, self.local_batch,
+        return sampler
+
+    def _loader(self, ds, train: bool, epoch: int) -> DataLoader:
+        return DataLoader(ds, self._sampler(ds, train, epoch), self.local_batch,
                           workers=self.cfg.workers, emit_valid=not train)
 
     # ------------------------------------------------------------------
     def train_epoch(self, epoch: int) -> Dict[str, float]:
+        if self.k > 1 or self.device_data:
+            return self._train_epoch_windowed(epoch)
         cfg = self.cfg
         loader = self._loader(self.train_ds, True, epoch)
         nb = len(loader)
@@ -227,7 +291,103 @@ class Trainer:
                 meters.display(i)
             end = time.time()
         return {"loss": meters.avg("Loss"), "top1": meters.avg("Acc@1"),
-                "top5": meters.avg("Acc@5")}
+                "top5": meters.avg("Acc@5"), "batches": nb - skip}
+
+    def _host_windows(self, loader, skip: int):
+        """Yield (n_batches, (imgs (K,B,...), lbls (K,B))) host-stacked
+        windows, skipping the first ``skip`` batches (step-exact resume). A
+        short tail yields a smaller window (jit retraces once per K)."""
+        it = iter(loader)
+        for _ in range(skip):
+            next(it)
+        while True:
+            stack = []
+            for batch in it:
+                stack.append(batch)
+                if len(stack) == self.k:
+                    break
+            if not stack:
+                return
+            imgs = np.stack([b[0] for b in stack])
+            lbls = np.stack([b[1] for b in stack])
+            yield len(stack), (imgs, lbls)
+
+    def _device_windows(self, epoch: int, skip: int, put):
+        """(K,B) index windows for the HBM-resident dataset, already ON
+        device. The transfers are dispatched asynchronously here, so calling
+        this for epoch e+1 while epoch e's validation runs hides the
+        host->device index upload entirely (epoch-granularity prefetch)."""
+        sampler = self._sampler(self.train_ds, True, epoch)
+        idx, _ = sampler.indices_with_valid()
+        nb = sampler.num_samples // self.local_batch
+        batches = np.asarray(idx[:nb * self.local_batch],
+                             np.int32).reshape(nb, self.local_batch)[skip:]
+        return [(len(w), put(np.ascontiguousarray(w)))
+                for w in (batches[i:i + self.k]
+                          for i in range(0, len(batches), self.k))]
+
+    def _train_epoch_windowed(self, epoch: int) -> Dict[str, float]:
+        """K-steps-per-dispatch epoch (VERDICT r1 #3): same math as the
+        per-batch loop, ~1/K the host round-trips, and (device mode) only
+        index windows cross the host->device link."""
+        cfg = self.cfg
+        nb = self.steps_per_epoch  # == len(loader): sampler pads to batches
+        meters = MeterBank(nb, [("Time", "6.3f"), ("Data", "6.3f"),
+                                ("Loss", ".4e"), ("Acc@1", "6.3f"),
+                                ("Acc@5", "6.3f")],
+                           prefix=f"Epoch: [{epoch}]")
+        skip = self._skip_batches
+        self._skip_batches = 0
+        win_sh = NamedSharding(self.mesh, P(None, "data"))
+        put = partial(assemble_global, win_sh)
+        if self.device_data:
+            def dispatch(state, dev_payload):
+                return self.window_step(state, *self._train_data_dev,
+                                        dev_payload, self.rng)
+
+            cached = self._prefetched_windows
+            self._prefetched_windows = None
+            if cached is not None and cached[0] == epoch and skip == 0:
+                windows = cached[1]
+            else:
+                windows = self._device_windows(epoch, skip, put)
+        else:
+            def dispatch(state, dev_payload):
+                return self.window_step(state, *dev_payload, self.rng)
+
+            loader = self._loader(self.train_ds, True, epoch)
+            windows = ((n, put(p)) for n, p in self._host_windows(loader, skip))
+
+        pending = []  # window metric sums awaiting the next print boundary
+        done = skip
+        last_print = skip - 1
+        end = time.time()
+        for n, dev_payload in windows:
+            meters.update("Data", time.time() - end, n)
+            self.state, metrics = dispatch(self.state, dev_payload)
+            done += n
+            pending.append(metrics)
+            boundary = (done - 1) - last_print >= cfg.print_freq or done == nb
+            if boundary and done == nb and self.device_data \
+                    and epoch + 1 < cfg.epochs:
+                # queue next epoch's index uploads BEFORE blocking on this
+                # epoch's metrics: they land during drain/validate/checkpoint
+                self._prefetched_windows = (
+                    epoch + 1, self._device_windows(epoch + 1, 0, put))
+            if boundary:
+                for m in jax.device_get(pending):
+                    cnt = float(m["count"])
+                    meters.update("Loss", float(m["loss_sum"]) / cnt, int(cnt))
+                    meters.update("Acc@1", float(m["correct1"]) / cnt, int(cnt))
+                    meters.update("Acc@5", float(m["correct5"]) / cnt, int(cnt))
+                pending = []
+                last_print = done - 1
+            meters.update("Time", time.time() - end, n)
+            if boundary and self.is_main:
+                meters.display(done - 1)
+            end = time.time()
+        return {"loss": meters.avg("Loss"), "top1": meters.avg("Acc@1"),
+                "top5": meters.avg("Acc@5"), "batches": nb - skip}
 
     def validate(self, epoch: int = 0) -> float:
         """Distributed eval (C15): metric sums psum'd across replicas, padding
@@ -292,17 +452,26 @@ class Trainer:
             self._epoch_in_progress = epoch
             t0 = time.time()
             train_metrics = self.train_epoch(epoch)
+            train_secs = time.time() - t0
             acc1 = self.validate(epoch)
             epoch_secs = time.time() - t0
+            # end-to-end train-phase rate (loader + dispatch + device), the
+            # number the bench's device rate is compared against in
+            # BASELINE.md; counts only batches actually trained (a resumed
+            # mid-epoch runs fewer than steps_per_epoch)
+            train_imgs = train_metrics.get(
+                "batches", self.steps_per_epoch) * cfg.batch_size
+            train_ips = train_imgs / max(train_secs, 1e-9)
             is_best = acc1 > self.best_acc1
             self.best_acc1 = max(acc1, self.best_acc1)
             if csv_path and self.is_main:
-                # reference CSV format: [wall start, epoch seconds]
+                # reference CSV format [wall start, epoch seconds] + a third
+                # column: train-phase images/sec (tpu_dist extension)
                 with open(csv_path, "a+", newline="") as f:
-                    csv.writer(f).writerow([t0, epoch_secs])
+                    csv.writer(f).writerow([t0, epoch_secs, round(train_ips, 1)])
             ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, epoch + 1,
                                  self.best_acc1, cfg.arch, is_best,
                                  extra_meta=self._run_meta)
             self.log(f"Epoch {epoch}: train_loss={train_metrics['loss']:.4f} "
                      f"val_acc1={acc1 * 100:.3f} best={self.best_acc1 * 100:.3f} "
-                     f"({epoch_secs:.1f}s)")
+                     f"({epoch_secs:.1f}s, train {train_ips:,.0f} img/s)")
