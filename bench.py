@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Headline benchmark: CIFAR10 ResNet-50 training throughput per chip.
+"""Headline benchmark: CIFAR10 ResNet-50 training throughput per chip + MFU.
 
 BASELINE.md: the reference publishes no numbers; this repo establishes the
 baseline (images/sec/chip on the flagship config, scripts/7.jax_tpu.py:
@@ -11,9 +11,16 @@ tpu_dist.engine.steps.make_multi_train_step) so controller/dispatch latency
 device-rate measurement; best window of several trials is reported (median
 and all trials inform stderr diagnostics).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is vs BASELINE.json's published number when present, else 1.0
-(this run IS the baseline).
+MFU accounting (VERDICT r1 #4): per-step FLOPs come from XLA's own cost
+model (compiled.cost_analysis()), peak from the device kind (override with
+BENCH_PEAK_TFLOPS). Set BENCH_SWEEP=1 for a stderr table over per-chip batch
+sizes and both ResNet stems (the 7x7/s2+maxpool ImageNet stem shrinks 32x32
+inputs to 8x8 before stage 1 and starves the MXU; `cifar_stem=True` is the
+standard 3x3/s1 CIFAR variant).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu",
+"tflops", "flops_per_img"}. vs_baseline is vs BASELINE.json's published
+number when present, else 1.0 (this run IS the baseline).
 """
 
 import json
@@ -23,14 +30,33 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# bf16 peak TFLOP/s per chip by device kind (public spec sheets)
+PEAK_TFLOPS = (
+    ("v6", 918.0), ("trillium", 918.0),
+    ("v5p", 459.0),
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5litepod", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
 
-def main():
+
+def peak_tflops_for(device) -> float | None:
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in PEAK_TFLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def build(model_kwargs, batch, k):
     import jax
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ.get("JAX_CACHE_DIR", "/tmp/jaxcache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
     import jax.numpy as jnp
     import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from tpu_dist.data import make_transform
     from tpu_dist.data.datasets import CIFAR10_MEAN, CIFAR10_STD
@@ -39,20 +65,10 @@ def main():
     from tpu_dist.models import create_model
     from tpu_dist.ops import make_optimizer
     from tpu_dist.parallel.mesh import make_mesh, replicated
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    n_chips = jax.device_count()
-    per_chip_batch = int(os.environ.get("BENCH_PER_CHIP_BATCH", "1024"))
-    batch = per_chip_batch * n_chips
-    # BENCH_STEPS kept as an alias (earlier recipe name). K=160 amortizes
-    # dispatch latency to <8% of the window (device-side rate ~148k img/s/chip
-    # per the XLA trace; measured wall rate 137k at K=160 vs 95k at K=20).
-    k = int(os.environ.get("BENCH_STEPS_PER_WINDOW",
-                           os.environ.get("BENCH_STEPS", "160")))
-    trials = int(os.environ.get("BENCH_TRIALS", "4"))
 
     mesh = make_mesh()
-    model = create_model("resnet50", num_classes=10, dtype=jnp.bfloat16)
+    model = create_model("resnet50", num_classes=10, dtype=jnp.bfloat16,
+                         **model_kwargs)
     params, batch_stats = init_model(model, jax.random.PRNGKey(0), (2, 32, 32, 3))
     tx = make_optimizer(0.1, 0.9, 1e-4, steps_per_epoch=100)
     state = jax.device_put(TrainState.create(params, batch_stats, tx),
@@ -60,13 +76,41 @@ def main():
     transform = make_transform(CIFAR10_MEAN, CIFAR10_STD, dtype=jnp.bfloat16)
     step = make_multi_train_step(model, tx, transform, mesh)
 
+    from tpu_dist.engine.steps import make_train_step
+    single = make_train_step(model, tx, transform, mesh, donate=False)
+
     rng = np.random.default_rng(0)
     images = rng.integers(0, 255, (k, batch, 32, 32, 3)).astype(np.uint8)
     labels = rng.integers(0, 10, (k, batch)).astype(np.int32)
     sh_img = NamedSharding(mesh, P(None, "data"))
     images = jax.device_put(images, sh_img)
     labels = jax.device_put(labels, sh_img)
+    return step, single, state, images, labels
+
+
+def flops_per_step(single, state, images, labels, key) -> float | None:
+    """One training step's FLOPs from XLA's cost model (the SINGLE-step
+    program — the scan flavor's cost analysis counts its body only once,
+    so it can't be trusted for per-step math); None if unavailable."""
+    try:
+        cost = single.lower(state, images[0], labels[0],
+                            key).compile().cost_analysis()
+        if isinstance(cost, list):  # older API: one dict per device program
+            cost = cost[0]
+        return float(cost["flops"])
+    except Exception as e:
+        print(f"cost_analysis unavailable: {e!r}", file=sys.stderr)
+        return None
+
+
+def measure(model_kwargs, per_chip_batch, k, trials):
+    import jax
+
+    n_chips = jax.device_count()
+    batch = per_chip_batch * n_chips
+    step, single, state, images, labels = build(model_kwargs, batch, k)
     key = jax.random.PRNGKey(0)
+    step_flops = flops_per_step(single, state, images, labels, key)
 
     # warmup: compile + one full window
     state, metrics = step(state, images, labels, key)
@@ -79,11 +123,60 @@ def main():
         jax.block_until_ready(metrics)
         dt = time.perf_counter() - t0
         rates.append(batch * k / dt)
-    best = max(rates)
-    print(f"trials (img/s): {[round(r) for r in sorted(rates)]}",
-          file=sys.stderr)
+    return max(rates), sorted(rates), step_flops, batch
 
-    ips_per_chip = best / n_chips
+
+def main():
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_CACHE_DIR", "/tmp/jaxcache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+    n_chips = jax.device_count()
+    per_chip_batch = int(os.environ.get("BENCH_PER_CHIP_BATCH", "1024"))
+    # BENCH_STEPS kept as an alias (earlier recipe name). K=160 amortizes
+    # dispatch latency to <8% of the window (device-side rate ~148k img/s/chip
+    # per the XLA trace; measured wall rate 137k at K=160 vs 95k at K=20).
+    k = int(os.environ.get("BENCH_STEPS_PER_WINDOW",
+                           os.environ.get("BENCH_STEPS", "160")))
+    trials = int(os.environ.get("BENCH_TRIALS", "4"))
+    peak = peak_tflops_for(jax.devices()[0])
+
+    def report(tag, best, rates, step_flops, batch):
+        ips_chip = best / n_chips
+        tflops = mfu = fpi = None
+        if step_flops:
+            # cost_analysis describes the per-device SPMD program, which
+            # processes batch/n_chips images per step
+            fpi = step_flops / (batch / n_chips)
+            tflops = ips_chip * fpi / 1e12
+            mfu = tflops / peak if peak else None
+        print(f"{tag}: {ips_chip:,.0f} img/s/chip, trials "
+              f"{[round(r / n_chips) for r in rates]}"
+              + (f", {fpi / 1e9:.3f} GFLOP/img, {tflops:.1f} TFLOP/s/chip"
+                 if fpi else "")
+              + (f", MFU {mfu * 100:.1f}% of {peak} TF peak" if mfu else ""),
+              file=sys.stderr)
+        return ips_chip, tflops, mfu, fpi
+
+    if os.environ.get("BENCH_SWEEP") == "1":
+        for stem in (False, True):
+            for pcb in (1024, 2048, 4096):
+                try:
+                    res = measure({"cifar_stem": stem}, pcb,
+                                  min(k, 40), max(2, trials // 2))
+                    report(f"sweep stem={'cifar' if stem else 'imagenet'} "
+                           f"b/chip={pcb} k={min(k, 40)}", *res)
+                except Exception as e:
+                    print(f"sweep stem={stem} b={pcb}: failed {e!r}",
+                          file=sys.stderr)
+
+    stem = os.environ.get("BENCH_CIFAR_STEM") == "1"
+    best, rates, window_flops, batch = measure(
+        {"cifar_stem": stem} if stem else {}, per_chip_batch, k, trials)
+    ips_per_chip, tflops, mfu, fpi = report("headline", best, rates,
+                                            window_flops, batch)
+
     baseline = None
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -99,6 +192,9 @@ def main():
         "value": round(ips_per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs, 3),
+        "mfu": round(mfu, 4) if mfu else None,
+        "tflops": round(tflops, 2) if tflops else None,
+        "flops_per_img": round(fpi) if fpi else None,
     }))
 
 

@@ -23,6 +23,11 @@ DEFAULTS = TrainConfig(arch="resnet50", epochs=10, batch_size=1024,
 
 if __name__ == "__main__":
     cfg = parse_config(defaults=DEFAULTS, description=__doc__)
+    if cfg.variant != "jit" and cfg.steps_per_dispatch == DEFAULTS.steps_per_dispatch:
+        # windowed dispatch is a jit-variant feature; an explicit
+        # --steps-per-dispatch with shard_map still errors clearly in Trainer
+        import dataclasses
+        cfg = dataclasses.replace(cfg, steps_per_dispatch=1)
     info = launch.initialize()
     print(f"[proc {info.process_id}/{info.num_processes}] via {info.method}")
     best = Trainer(cfg).fit()

@@ -149,8 +149,11 @@ class Trainer:
             # shard_map config through it would silently drop grad
             # compression/predivide and per-replica BN semantics
             raise ValueError("data_placement='device' requires variant='jit'")
+        fits_hbm = (in_memory and self.train_ds.images.nbytes
+                    <= int(os.environ.get("TPU_DIST_DEVICE_DATA_MAX",
+                                          str(1 << 30))))
         self.device_data = (cfg.data_placement == "device" or
-                            (cfg.data_placement == "auto" and in_memory
+                            (cfg.data_placement == "auto" and fits_hbm
                              and self.k > 1))
         self._train_data_dev = None
         self._prefetched_windows = None  # (epoch, [(n, device idx window)])
@@ -251,6 +254,17 @@ class Trainer:
         return DataLoader(ds, self._sampler(ds, train, epoch), self.local_batch,
                           workers=self.cfg.workers, emit_valid=not train)
 
+    @staticmethod
+    def _drain(pending, meters) -> None:
+        """Pull queued device metric sums into the meter bank (ONE blocking
+        transfer per print window — the async-dispatch sync point)."""
+        for m in jax.device_get(pending):
+            cnt = float(m["count"])
+            meters.update("Loss", float(m["loss_sum"]) / cnt, int(cnt))
+            meters.update("Acc@1", float(m["correct1"]) / cnt, int(cnt))
+            meters.update("Acc@5", float(m["correct5"]) / cnt, int(cnt))
+        pending.clear()
+
     # ------------------------------------------------------------------
     def train_epoch(self, epoch: int) -> Dict[str, float]:
         if self.k > 1 or self.device_data:
@@ -277,12 +291,7 @@ class Trainer:
             pending.append(metrics)
             boundary = i % cfg.print_freq == 0 or i == nb - 1
             if boundary:
-                for m in jax.device_get(pending):
-                    n = float(m["count"])
-                    meters.update("Loss", float(m["loss_sum"]) / n, int(n))
-                    meters.update("Acc@1", float(m["correct1"]) / n, int(n))
-                    meters.update("Acc@5", float(m["correct5"]) / n, int(n))
-                pending = []
+                self._drain(pending, meters)
             # every iteration, so avg(Time) = wall/batches; under async
             # dispatch the device wait lands on boundary iterations (the
             # device_get above) and non-boundary Time is dispatch-only
@@ -375,12 +384,7 @@ class Trainer:
                 self._prefetched_windows = (
                     epoch + 1, self._device_windows(epoch + 1, 0, put))
             if boundary:
-                for m in jax.device_get(pending):
-                    cnt = float(m["count"])
-                    meters.update("Loss", float(m["loss_sum"]) / cnt, int(cnt))
-                    meters.update("Acc@1", float(m["correct1"]) / cnt, int(cnt))
-                    meters.update("Acc@5", float(m["correct5"]) / cnt, int(cnt))
-                pending = []
+                self._drain(pending, meters)
                 last_print = done - 1
             meters.update("Time", time.time() - end, n)
             if boundary and self.is_main:
