@@ -1,0 +1,54 @@
+"""Convergence regression bound (the north-star metric's fast guard).
+
+BASELINE.md records steps-to-90% val top-1 for the TPU configs (measured
+with tools/convergence.py). This test pins the cheap CPU-sized version of
+the same property: if the engine's math, sampler, LR schedule, or metric
+reduction regress, the model stops clearing the threshold within the
+recorded step budget. Bound = recorded steps + margin, per SURVEY.md §4
+(the reference's only QA was convergence — README_EN.md:10).
+"""
+
+import jax
+
+from tpu_dist.configs import TrainConfig
+from tpu_dist.engine import Trainer
+
+# recorded on the 8-virtual-device CPU mesh: lenet/synthetic-mnist clears
+# 90% val top-1 in ONE epoch (32 steps) for every engine flavor; bound 2
+# epochs = 64 steps for margin.
+RECORDED_STEPS = 32
+BOUND_STEPS = 64
+
+
+def _converges(variant, precision, tmp, k=1):
+    cfg = TrainConfig(
+        arch="lenet", dataset="synthetic-mnist", variant=variant,
+        precision=precision, batch_size=64, synth_train_size=2048,
+        synth_val_size=512, seed=0, epochs=2, print_freq=10 ** 9,
+        steps_per_dispatch=k, checkpoint_dir=tmp)
+    tr = Trainer(cfg)
+    for epoch in range(cfg.epochs):
+        tr.train_epoch(epoch)
+        acc = tr.validate(epoch)
+        steps = int(jax.device_get(tr.state.step))
+        if acc >= 0.90:
+            return steps
+    raise AssertionError(
+        f"{variant}/{precision}: {acc * 100:.1f}% after {steps} steps "
+        f"(bound {BOUND_STEPS})")
+
+
+def test_jit_fp32_converges_within_bound(tmp_path):
+    assert _converges("jit", "fp32", str(tmp_path)) <= BOUND_STEPS
+
+
+def test_jit_bf16_converges_within_bound(tmp_path):
+    assert _converges("jit", "bf16", str(tmp_path)) <= BOUND_STEPS
+
+
+def test_shard_map_converges_within_bound(tmp_path):
+    assert _converges("shard_map", "fp32", str(tmp_path)) <= BOUND_STEPS
+
+
+def test_windowed_dispatch_converges_within_bound(tmp_path):
+    assert _converges("jit", "bf16", str(tmp_path), k=8) <= BOUND_STEPS
