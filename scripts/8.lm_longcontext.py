@@ -7,6 +7,8 @@ trains a causal LM with the parallelism picked by flags:
   --mesh data=8                 pure data parallel (jit)
   --mesh data=2,seq=4           sequence parallel: ring attention over 'seq'
   --mesh data=4,model=2         tensor parallel: Megatron shardings via GSPMD
+  --mesh data=2,stage=4         pipeline parallel: GPipe microbatches over
+                                'stage' (--pp-microbatches)
 
 Data is a synthetic deterministic token stream (affine next-token rule +
 noise) so the loss curve is meaningful without downloads. Prints per-step
@@ -54,6 +56,8 @@ def main():
                     help="MoE feed-forward with N experts (0 = dense); with "
                          "--mesh data=2,expert=4 experts shard over the "
                          "'expert' axis (GShard-style expert parallelism)")
+    ap.add_argument("--pp-microbatches", type=int, default=4,
+                    help="GPipe microbatches per step (with a 'stage' axis)")
     ap.add_argument("--checkpoint-dir", default="",
                     help="save checkpoints here (also on Ctrl-C); empty = off")
     ap.add_argument("--save-freq", type=int, default=0,
@@ -100,6 +104,10 @@ def main():
     use_sp = "seq" in mesh.axis_names and mesh.shape["seq"] > 1
     use_tp = "model" in mesh.axis_names and mesh.shape["model"] > 1
     use_ep = "expert" in mesh.axis_names and mesh.shape["expert"] > 1
+    use_pp = "stage" in mesh.axis_names and mesh.shape["stage"] > 1
+    if use_pp and (use_sp or use_tp or use_ep or args.num_experts or args.fsdp):
+        raise SystemExit("a 'stage' mesh axis composes only with 'data' "
+                         "(GPipe over dense TransformerLM blocks)")
     if args.fsdp and (use_sp or use_tp or use_ep):
         print("warning: --fsdp applies to the pure data-parallel layout; "
               "ignored with a seq/model/expert mesh axis", flush=True)
@@ -112,8 +120,19 @@ def main():
         raise SystemExit("MoE + tensor parallelism not supported: the TP "
                          "rules don't shard 3-D expert weights — use "
                          "--mesh data=N,expert=M instead")
+    if use_pp:
+        # stacked layout BEFORE TrainState.create so the optimizer state
+        # mirrors it (also makes it the checkpoint/resume template)
+        from tpu_dist.parallel.pp import (make_lm_pp_train_step,
+                                          shard_state_pp,
+                                          stack_pipeline_params)
+        params = stack_pipeline_params(params, mesh.shape["stage"])
+        state = TrainState.create(params, {}, tx)
+
     def place(st):
         """Apply the mode's sharding; also re-places a resumed host state."""
+        if use_pp:
+            return shard_state_pp(mesh, st)
         if use_sp:
             return jax.device_put(st, replicated(mesh))
         if use_ep:
@@ -131,7 +150,10 @@ def main():
             return shard_state_fsdp(mesh, st)
         return jax.device_put(st, replicated(mesh))
 
-    if use_sp:
+    if use_pp:
+        step = make_lm_pp_train_step(model, tx, mesh, args.pp_microbatches)
+        data_spec = P("data", None)
+    elif use_sp:
         step = make_lm_sp_train_step(partial(tiny_lm, **lm_kw), tx, mesh)
         data_spec = P("data", "seq")
     else:
@@ -139,16 +161,20 @@ def main():
         data_spec = P("data")
 
     # model geometry stamped into every checkpoint; a mismatched resume must
-    # fail with a clear message, not a deep XLA shape error
+    # fail with a clear message, not a deep XLA shape error (or worse: a
+    # pp checkpoint resumed with a different stage count reshards the
+    # stage-stacked blocks wrongly and silently drops layers)
     geometry = {"vocab_size": args.vocab_size, "num_layers": args.num_layers,
                 "d_model": args.d_model, "num_heads": args.num_heads,
-                "seq_len": args.seq_len, "num_experts": args.num_experts}
+                "seq_len": args.seq_len, "num_experts": args.num_experts,
+                "pp_stages": mesh.shape["stage"] if use_pp else 0}
 
     start_step = 0
     if args.resume:
-        # load into the freshly-initialized (host) template, THEN shard —
-        # works for every mode because placement is orthogonal to the blob
-        state, meta = ckpt.load_checkpoint(args.resume, state)
+        # validate geometry from the meta header BEFORE deserializing: a
+        # wrong-shaped blob fails opaquely (or, for pp stage counts, loads
+        # and silently missplits the stage-stacked blocks)
+        meta = ckpt.read_checkpoint_meta(args.resume)
         bad = {k: (meta[k], v) for k, v in geometry.items()
                if k in meta and meta[k] != v}
         if bad:
@@ -156,6 +182,9 @@ def main():
                 "--resume checkpoint has different model geometry: " +
                 ", ".join(f"{k}: checkpoint {a} vs flags {b}"
                           for k, (a, b) in bad.items()))
+        # load into the freshly-initialized (host) template, THEN shard —
+        # works for every mode because placement is orthogonal to the blob
+        state, meta = ckpt.load_checkpoint(args.resume, state)
         start_step = int(np.asarray(state.step))
         if jax.process_index() == 0:
             print(f"=> resumed from {args.resume} (step {start_step})",
@@ -176,7 +205,8 @@ def main():
     inputs = jax.device_put(inputs, sh)
     targets = jax.device_put(targets, sh)
 
-    mode = ("sp-ring" if use_sp else
+    mode = ("pp-gpipe" if use_pp else
+            "sp-ring" if use_sp else
             "ep-moe" if use_ep else
             "tp" if use_tp else
             "fsdp" if args.fsdp else

@@ -96,6 +96,17 @@ def test_script_8_lm(tmp_path):
     assert "throughput" in out
 
 
+def test_script_8_lm_pipeline_mode(tmp_path):
+    out = run_script(tmp_path, "8.lm_longcontext.py",
+                     ["--mesh", "data=2,stage=2", "--steps", "3",
+                      "--batch-size", "4", "--seq-len", "32", "--d-model",
+                      "32", "--num-layers", "2", "--num-heads", "2",
+                      "--print-freq", "1", "--pp-microbatches", "2"],
+                     env_extra={"XLA_FLAGS":
+                                "--xla_force_host_platform_device_count=4"})
+    assert "mode=pp-gpipe" in out and "throughput" in out
+
+
 def test_script_evaluate_flag(tmp_path):
     # reference -e/--evaluate path (C1): eval-only run, no training
     out = run_script(tmp_path, "5.2.mnist.py",

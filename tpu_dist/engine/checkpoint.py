@@ -101,6 +101,20 @@ def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
     return path
 
 
+def read_checkpoint_meta(path: str) -> Dict:
+    """Metadata only, without deserializing the blob — validate geometry
+    BEFORE from_bytes (whose structure-mismatch errors are opaque)."""
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC) + 8)
+        if head.startswith(_MAGIC):
+            meta_len = int.from_bytes(head[len(_MAGIC):], "little")
+            return json.loads(f.read(meta_len))
+    if os.path.exists(path + ".json"):  # pre-container checkpoint
+        with open(path + ".json") as f:
+            return json.load(f)
+    return {}
+
+
 def load_checkpoint(path: str, template_state) -> Tuple[Any, Dict]:
     """Restore a TrainState saved by save_checkpoint into template's structure."""
     with open(path, "rb") as f:
