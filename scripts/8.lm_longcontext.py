@@ -58,6 +58,18 @@ def main():
                          "'expert' axis (GShard-style expert parallelism)")
     ap.add_argument("--pp-microbatches", type=int, default=4,
                     help="GPipe microbatches per step (with a 'stage' axis)")
+    ap.add_argument("--router-top-k", type=int, default=1, choices=[1, 2],
+                    help="MoE routing: 1 = Switch top-1, 2 = GShard top-2")
+    ap.add_argument("--attn", default="full",
+                    choices=["full", "blockwise", "flash"],
+                    help="attention flavor: full O(L^2) memory; blockwise "
+                         "online-softmax O(L*block); flash = Pallas forward "
+                         "kernel + recompute backward (non-sp meshes)")
+    ap.add_argument("--attn-block", type=int, default=512,
+                    help="KV block size for blockwise/flash recompute")
+    ap.add_argument("--remat", action="store_true",
+                    help="jax.checkpoint each transformer block (trade "
+                         "FLOPs for HBM; the long-context memory lever)")
     ap.add_argument("--checkpoint-dir", default="",
                     help="save checkpoints here (also on Ctrl-C); empty = off")
     ap.add_argument("--save-freq", type=int, default=0,
@@ -87,12 +99,26 @@ def main():
                                                         ("data",))
     mesh = make_mesh(mesh_shape, mesh_axes)
     policy = make_policy(args.precision)
+    if args.attn != "full":
+        from tpu_dist.ops.flash_attention import (blockwise_attention_fn,
+                                                  flash_attention_fn)
+        attn_fn = (blockwise_attention_fn(args.attn_block)
+                   if args.attn == "blockwise"
+                   else flash_attention_fn(recompute_block=args.attn_block))
+    else:
+        from tpu_dist.models.transformer import full_attention
+        attn_fn = full_attention
     lm_kw = dict(vocab_size=args.vocab_size, num_layers=args.num_layers,
                  d_model=args.d_model, num_heads=args.num_heads,
-                 max_len=args.seq_len, dtype=policy.compute_dtype)
+                 max_len=args.seq_len, dtype=policy.compute_dtype,
+                 attn_fn=attn_fn, remat=args.remat)
     if args.num_experts:
+        if args.remat:
+            raise SystemExit("--remat supports the dense TransformerLM only")
         from tpu_dist.models.moe import MoETransformerLM
-        model = MoETransformerLM(num_experts=args.num_experts, **lm_kw)
+        moe_kw = {k: v for k, v in lm_kw.items() if k != "remat"}
+        model = MoETransformerLM(num_experts=args.num_experts,
+                                 router_top_k=args.router_top_k, **moe_kw)
     else:
         model = tiny_lm(**lm_kw)
     params = model.init({"params": jax.random.PRNGKey(0)},
@@ -116,6 +142,9 @@ def main():
     if use_sp and args.num_experts:
         raise SystemExit("MoE + sequence parallelism not supported yet "
                          "(ring attention path builds the dense model)")
+    if use_sp and args.attn != "full":
+        print("warning: a 'seq' mesh axis uses ring attention; "
+              f"--attn {args.attn} ignored", flush=True)
     if use_tp and args.num_experts:
         raise SystemExit("MoE + tensor parallelism not supported: the TP "
                          "rules don't shard 3-D expert weights — use "
@@ -228,9 +257,14 @@ def main():
     key = jax.random.PRNGKey(1)
     i = start_step
     t0 = time.perf_counter()
+    timed_from = start_step  # first step compiles; throughput excludes it
     try:
         for i in range(start_step, args.steps):
             state, metrics = step(state, inputs, targets, key)
+            if i == start_step and args.steps - start_step > 1:
+                jax.block_until_ready(metrics)
+                t0 = time.perf_counter()
+                timed_from = start_step + 1
             if i % args.print_freq == 0 or i == args.steps - 1:
                 m = jax.device_get(metrics)
                 loss = float(m["loss_sum"]) / float(m["count"])
@@ -254,9 +288,10 @@ def main():
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
     save(state, args.steps)
-    toks = (args.steps - start_step) * args.batch_size * args.seq_len
+    toks = (args.steps - timed_from) * args.batch_size * args.seq_len
     if jax.process_index() == 0:
-        print(f"throughput {toks / dt:,.0f} tokens/sec ({mode})")
+        print(f"throughput {toks / dt:,.0f} tokens/sec ({mode}, "
+              f"{args.steps - timed_from} timed steps)")
 
 
 if __name__ == "__main__":

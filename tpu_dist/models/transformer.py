@@ -81,6 +81,9 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dtype: jnp.dtype = jnp.float32
     attn_fn: Callable = full_attention
+    remat: bool = False  # rematerialize each block's activations in the
+                         # backward pass (jax.checkpoint): trades FLOPs for
+                         # HBM — the long-context memory lever
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, pos_offset=0):
@@ -92,9 +95,11 @@ class TransformerLM(nn.Module):
         pos = pos_offset + jnp.arange(tokens.shape[1])
         x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
                          name="pos_emb")(pos)[None]
+        block_cls = (nn.remat(Block, static_argnums=(2,)) if self.remat
+                     else Block)
         for i in range(self.num_layers):
-            x = Block(self.num_heads, self.dtype, self.attn_fn,
-                      name=f"block{i}")(x, train=train)
+            x = block_cls(self.num_heads, self.dtype, self.attn_fn,
+                          name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                           name="lm_head")(x)
@@ -102,7 +107,8 @@ class TransformerLM(nn.Module):
 
 
 def tiny_lm(vocab_size=256, num_layers=2, d_model=64, num_heads=4,
-            max_len=512, dtype=jnp.float32, attn_fn=full_attention, **_):
+            max_len=512, dtype=jnp.float32, attn_fn=full_attention,
+            remat=False, **_):
     return TransformerLM(vocab_size=vocab_size, num_layers=num_layers,
                         d_model=d_model, num_heads=num_heads, max_len=max_len,
-                        dtype=dtype, attn_fn=attn_fn)
+                        dtype=dtype, attn_fn=attn_fn, remat=remat)

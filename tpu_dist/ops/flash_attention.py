@@ -1,0 +1,186 @@
+"""Memory-efficient attention: blockwise (online softmax) + Pallas flash.
+
+The reference has no attention at all (SURVEY.md §2c); tpu_dist's LM family
+takes a pluggable ``attn_fn`` (tpu_dist.models.transformer), so these drop
+into the SAME weights as full attention:
+
+* :func:`blockwise_attention_fn` — pure-JAX flash-attention math: a
+  ``lax.scan`` over KV blocks with a running (max, sum, acc) online softmax.
+  Never materializes the (B,H,L,L) score matrix — peak activation memory is
+  O(L * block) — and autodiff/remat work out of the box. Runs on any
+  backend; this is the long-context workhorse and the ground truth for the
+  kernel below.
+* :func:`flash_attention_fn` — Pallas TPU kernel for the forward hot path:
+  one grid step per (batch*head, q-block) computes q_blk @ k^T in VMEM
+  (scores never touch HBM), fp32 online math, causal masking by global
+  position. Backward is a ``jax.custom_vjp`` that recomputes through the
+  blockwise path (flash-style recompute instead of stashing probabilities).
+  VMEM bounds the kv length per head (~4k at head_dim 128 fp32); beyond
+  that use the blockwise path.
+
+Both are numerically validated against full attention (tests/test_flash.py)
+and compose with the causal offsets ring attention uses.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # avoids -inf - -inf = nan in the online max updates
+
+
+def _causal_mask(scores, q_pos, k_pos):
+    return jnp.where(k_pos[None, :] <= q_pos[:, None], scores, NEG_INF)
+
+
+def blockwise_attention_fn(block_size: int = 512):
+    """Returns attn(q, k, v, causal=True, q_offset=0, kv_offset=0).
+
+    Shapes follow the model convention: (B, L, H, D). fp32 softmax state
+    regardless of input dtype, like tpu_dist.models.transformer.full_attention.
+    """
+
+    def attn(q, k, v, *, causal: bool = True, q_offset=0, kv_offset=0):
+        b, lq, h, d = q.shape
+        lk = k.shape[1]
+        blk = min(block_size, lk)
+        if lk % blk:
+            raise ValueError(f"kv length {lk} not divisible by block {blk}")
+        nk = lk // blk
+        scale = 1.0 / math.sqrt(d)
+
+        # (B, L, H, D) -> (B, H, L, D) once; scan over KV blocks
+        qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
+        kh = jnp.swapaxes(k, 1, 2).reshape(b, h, nk, blk, d)
+        vh = jnp.swapaxes(v, 1, 2).reshape(b, h, nk, blk, d)
+        kh = jnp.moveaxis(kh, 2, 0)  # (nk, B, H, blk, D)
+        vh = jnp.moveaxis(vh, 2, 0)
+
+        q_pos = q_offset + jnp.arange(lq)
+
+        def body(carry, blk_in):
+            acc, m, l, i = carry
+            kb, vb = blk_in
+            s = jnp.einsum("bhqd,bhkd->bhqk", qh, kb.astype(jnp.float32))
+            if causal:
+                k_pos = kv_offset + i * blk + jnp.arange(blk)
+                s = jnp.where(k_pos[None, None, None, :]
+                              <= q_pos[None, None, :, None], s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[..., None])
+            l = l * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
+            return (acc, m_new, l, i + 1), None
+
+        acc0 = jnp.zeros((b, h, lq, d), jnp.float32)
+        m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, lq), jnp.float32)
+        (acc, _, l, _), _ = jax.lax.scan(
+            body, (acc0, m0, l0, jnp.int32(0)), (kh, vh))
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        return jnp.swapaxes(out, 1, 2).astype(v.dtype)
+
+    return attn
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, off_ref, o_ref, *, blk_q, causal):
+    import jax.experimental.pallas as pl
+
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)          # (blk_q, D)
+    k = k_ref[0].astype(jnp.float32)          # (Lk, D)
+    v = v_ref[0].astype(jnp.float32)          # (Lk, D)
+    d = q.shape[-1]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(d))                        # (blk_q, Lk) — VMEM only
+    if causal:
+        q_pos = off_ref[0] + iq * blk_q + jax.lax.iota(
+            jnp.int32, blk_q)
+        k_pos = off_ref[1] + jax.lax.iota(jnp.int32, s.shape[-1])
+        s = jnp.where(k_pos[None, :] <= q_pos[:, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.dot(p, v, preferred_element_type=jnp.float32) / jnp.maximum(
+        l, 1e-30)
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, q_offset, kv_offset, blk_q, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    bq = min(blk_q, lq)
+    if lq % bq:
+        raise ValueError(f"q length {lq} not divisible by block {bq}")
+    # (B, L, H, D) -> (B*H, L, D)
+    fold = lambda x: jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    offsets = jnp.asarray([q_offset, kv_offset], jnp.int32)
+
+    grid = (b * h, lq // bq)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, blk_q=bq, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0)),
+            # constant in iq -> fetched once per (batch, head)
+            pl.BlockSpec((1, lk, d), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec((1, lk, d), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, v.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, offsets)
+    return jnp.swapaxes(out.reshape(b, h, lq, d), 1, 2)
+
+
+def flash_attention_fn(block_q: int = 128, recompute_block: int = 512,
+                       interpret: bool | None = None):
+    """Returns a Pallas-forward attention with recompute backward.
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the same
+    code runs in the CPU test mesh.
+    """
+
+    def pick_interpret():
+        if interpret is not None:
+            return interpret
+        return jax.default_backend() != "tpu"
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+    def attn_core(q, k, v, causal, q_offset, kv_offset):
+        return _flash_fwd(q, k, v, causal, q_offset, kv_offset,
+                          block_q, pick_interpret())
+
+    def fwd(q, k, v, causal, q_offset, kv_offset):
+        return attn_core(q, k, v, causal, q_offset, kv_offset), (q, k, v)
+
+    def bwd(causal, q_offset, kv_offset, res, g):
+        q, k, v = res
+        ref = blockwise_attention_fn(recompute_block)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: ref(q_, k_, v_, causal=causal,
+                                   q_offset=q_offset, kv_offset=kv_offset),
+            q, k, v)
+        return vjp(g)
+
+    attn_core.defvjp(fwd, bwd)
+
+    def attn(q, k, v, *, causal: bool = True, q_offset=0, kv_offset=0):
+        return attn_core(q, k, v, causal, q_offset, kv_offset)
+
+    return attn

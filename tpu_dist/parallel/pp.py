@@ -128,6 +128,8 @@ def make_lm_pp_train_step(model, tx, mesh: Mesh, num_microbatches: int,
         # blocks_local leaves: (layers_per_stage, ...) — homogeneous scan
         def one(h, bp):
             return block.apply({"params": bp}, h), None
+        if model.remat:  # same per-block checkpointing as the dense path
+            one = jax.checkpoint(one)
         x, _ = jax.lax.scan(one, x, blocks_local)
         return x
 
