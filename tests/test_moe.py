@@ -23,8 +23,9 @@ def test_moe_mlp_shapes_and_aux():
     variables = m.init(jax.random.PRNGKey(0), x)
     out, muts = m.apply(variables, x, mutable=["intermediates"])
     assert out.shape == x.shape
-    (aux,) = jax.tree.leaves(muts["intermediates"])
+    (aux,) = muts["intermediates"]["aux_loss"]
     # balanced-uniform lower bound is 1.0; any gating gives >= 1
+    # (plus the small z-loss term)
     assert float(aux) >= 0.99
 
 
@@ -96,3 +97,59 @@ def test_expert_parallel_matches_dp(moe_setup):
     a = float(jax.device_get(m_dp["loss_sum"]))
     b = float(jax.device_get(m_ep["loss_sum"]))
     assert b == pytest.approx(a, rel=1e-4)
+
+
+def test_top2_routing_dispatches_two_experts():
+    """Top-2: every token's combine weights sum to ~1 (renormalized gates
+    over BOTH dispatched experts); top-1's sum to gate1 < 1."""
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 8, 16)),
+                    jnp.float32)
+    m2 = MoEMLP(num_experts=E, router_top_k=2, capacity_factor=4.0)
+    variables = m2.init(jax.random.PRNGKey(0), x)
+    out, muts = m2.apply(variables, x, mutable=["intermediates"])
+    assert out.shape == x.shape
+    (mass2,) = muts["intermediates"]["combine_mass"]
+    np.testing.assert_allclose(np.asarray(mass2),
+                               np.ones_like(np.asarray(mass2)), atol=1e-5)
+    m1 = MoEMLP(num_experts=E, router_top_k=1, capacity_factor=4.0)
+    out1, muts1 = m1.apply(variables, x, mutable=["intermediates"])
+    (mass1,) = muts1["intermediates"]["combine_mass"]
+    # top-1 mass = gate1 strictly below 1 (softmax over E>=2 experts)
+    assert float(jnp.max(mass1)) < 1.0
+    # and the second expert's contribution changes the output
+    assert float(jnp.max(jnp.abs(out - out1))) > 1e-6
+
+
+def test_top2_moe_lm_trains(moe_setup):
+    _, _, tx, inputs, targets = (*moe_setup,)
+    model = MoETransformerLM(vocab_size=V, max_len=L, num_experts=E,
+                             router_top_k=2)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, L), jnp.int32), train=False)["params"]
+    mesh = make_mesh((8,), ("data",))
+    state = jax.device_put(TrainState.create(params, {}, tx),
+                           replicated(mesh))
+    step = make_lm_train_step(model, tx, mesh, donate=False)
+    sh = NamedSharding(mesh, P("data"))
+    di, dt = jax.device_put(inputs, sh), jax.device_put(targets, sh)
+    key = jax.random.PRNGKey(1)
+    losses = []
+    for _ in range(6):
+        state, m = step(state, di, dt, key)
+        losses.append(float(jax.device_get(m["loss_sum"]))
+                      / float(jax.device_get(m["count"])))
+    assert losses[-1] < losses[0], losses
+
+
+def test_router_z_loss_in_aux():
+    """z-loss contributes: scaling it changes the sown aux value."""
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(2, 8, 16)),
+                    jnp.float32)
+    lo = MoEMLP(num_experts=E, z_loss_coef=0.0)
+    hi = MoEMLP(num_experts=E, z_loss_coef=10.0)
+    variables = lo.init(jax.random.PRNGKey(0), x)
+    _, m_lo = lo.apply(variables, x, mutable=["intermediates"])
+    _, m_hi = hi.apply(variables, x, mutable=["intermediates"])
+    (a_lo,) = m_lo["intermediates"]["aux_loss"]
+    (a_hi,) = m_hi["intermediates"]["aux_loss"]
+    assert float(a_hi) > float(a_lo)

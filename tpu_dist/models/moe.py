@@ -41,10 +41,16 @@ class MoEMLP(nn.Module):
     mlp_ratio: int = 4
     capacity_factor: float = 1.25
     group_size: int = 512
+    router_top_k: int = 1      # 1 = Switch, 2 = GShard-style top-2
+    z_loss_coef: float = 1e-3  # router z-loss weight RELATIVE to the balance
+                               # loss (both ride the single sown aux_loss,
+                               # scaled by the step's aux_weight)
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        if self.router_top_k not in (1, 2):
+            raise ValueError("router_top_k must be 1 or 2")
         b, l, d = x.shape
         t = b * l
         e = self.num_experts
@@ -53,7 +59,7 @@ class MoEMLP(nn.Module):
         if t % s:  # group size must divide tokens; fall back to batch rows
             s = l
         g = t // s
-        cap = max(1, int(s / e * self.capacity_factor))
+        cap = max(1, int(s / e * self.capacity_factor * self.router_top_k))
 
         tokens = x.reshape(g, s, d)
         gate_logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
@@ -69,10 +75,40 @@ class MoEMLP(nn.Module):
         # dispatch tensor (G, S, E, C): one-hot over capacity slots
         disp = keep[..., None] * jax.nn.one_hot(pos, cap, dtype=jnp.float32)
 
-        # Switch aux loss: E * sum_e( token_fraction_e * mean_prob_e )
+        if self.router_top_k == 1:
+            combine = disp * gate[..., None, None]
+        else:
+            # second choice: argmax with the first expert masked out; its
+            # tokens queue BEHIND every first-choice token of that expert
+            # (GShard order), and the two gates renormalize to sum to 1
+            probs2 = probs * (1.0 - onehot)
+            idx2 = jnp.argmax(probs2, axis=-1)
+            gate2 = jnp.max(probs2, axis=-1)
+            denom = jnp.maximum(gate + gate2, 1e-9)
+            combine = disp * (gate / denom)[..., None, None]
+            onehot2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+            count1 = jnp.sum(keep, axis=1, keepdims=True)     # (G, 1, E)
+            pos2 = (jnp.cumsum(onehot2, axis=1) * onehot2 - onehot2
+                    + count1 * onehot2).astype(jnp.int32)
+            keep2 = (pos2 < cap).astype(jnp.float32) * onehot2
+            disp2 = keep2[..., None] * jax.nn.one_hot(pos2, cap,
+                                                      dtype=jnp.float32)
+            disp = disp + disp2
+            combine = combine + disp2 * (gate2 / denom)[..., None, None]
+
+        # Switch aux loss: E * sum_e( token_fraction_e * mean_prob_e ),
+        # plus the router z-loss mean(logsumexp(logits)^2) that keeps gate
+        # logits from drifting to magnitudes where softmax saturates
         frac = jnp.mean(onehot, axis=(0, 1))
         mean_prob = jnp.mean(probs, axis=(0, 1))
-        self.sow("intermediates", "aux_loss", e * jnp.sum(frac * mean_prob))
+        z = jnp.mean(jax.scipy.special.logsumexp(gate_logits, axis=-1) ** 2)
+        self.sow("intermediates", "aux_loss",
+                 e * jnp.sum(frac * mean_prob) + self.z_loss_coef * z)
+        # diagnostic (NOT part of the objective — the step only sums
+        # 'aux_loss' leaves): per-token combine mass, ~gate1 for top-1 and
+        # ~1.0 for top-2 when capacity admits both choices
+        self.sow("intermediates", "combine_mass",
+                 jnp.sum(combine, axis=(-2, -1)))
 
         w_in = self.param("w_in", nn.initializers.lecun_normal(),
                           (e, d, f)).astype(self.dtype)
@@ -85,8 +121,8 @@ class MoEMLP(nn.Module):
         h = jnp.einsum("gecd,edf->gecf", expert_in, w_in)
         h = nn.gelu(h)
         expert_out = jnp.einsum("gecf,efd->gecd", h, w_out)    # (G, E, C, D)
-        combine = disp_c * gate[..., None, None].astype(self.dtype)
-        out = jnp.einsum("gsec,gecd->gsd", combine, expert_out)
+        out = jnp.einsum("gsec,gecd->gsd", combine.astype(self.dtype),
+                         expert_out)
         # dropped tokens (over capacity) pass through the residual unchanged
         return out.reshape(b, l, d)
 
@@ -98,6 +134,7 @@ class MoEBlock(nn.Module):
     num_experts: int = 4
     dtype: jnp.dtype = jnp.float32
     attn_fn: Callable = None  # default set in __call__ to avoid import cycle
+    router_top_k: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -115,7 +152,8 @@ class MoEBlock(nn.Module):
         x = x + nn.Dense(d_model, use_bias=False, dtype=self.dtype,
                          name="proj")(out.reshape(x.shape))
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        x = x + MoEMLP(self.num_experts, dtype=self.dtype, name="moe")(h, train)
+        x = x + MoEMLP(self.num_experts, dtype=self.dtype,
+                       router_top_k=self.router_top_k, name="moe")(h, train)
         return x
 
 
@@ -130,6 +168,7 @@ class MoETransformerLM(nn.Module):
     max_len: int = 512
     dtype: jnp.dtype = jnp.float32
     attn_fn: Callable = None
+    router_top_k: int = 1
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, pos_offset=0):
@@ -140,7 +179,8 @@ class MoETransformerLM(nn.Module):
                          name="pos_emb")(pos)[None]
         for i in range(self.num_layers):
             x = MoEBlock(self.num_heads, self.num_experts, self.dtype,
-                         self.attn_fn, name=f"block{i}")(x, train=train)
+                         self.attn_fn, self.router_top_k,
+                         name=f"block{i}")(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                           name="lm_head")(x)
