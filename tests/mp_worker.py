@@ -46,7 +46,8 @@ def main() -> None:
         arch="lenet", dataset="synthetic", epochs=1, batch_size=16, lr=0.05,
         workers=1, print_freq=100, seed=0, synth_train_size=64,
         synth_val_size=32, checkpoint_dir=os.path.join(out, "ckpt"),
-        variant=os.environ.get("TPU_DIST_TEST_VARIANT", "jit"))
+        variant=os.environ.get("TPU_DIST_TEST_VARIANT", "jit"),
+        steps_per_dispatch=int(os.environ.get("TPU_DIST_TEST_K", "1")))
     trainer = Trainer(cfg)
     best = trainer.fit()
 

@@ -45,10 +45,12 @@ def _worker_env(outdir: str, nprocs: int, local_devices: int) -> dict:
 
 
 def run_workers(tmp, tag: str, nprocs: int, local_devices: int,
-                timeout: int = 420, worker: str = WORKER) -> str:
+                timeout: int = 420, worker: str = WORKER,
+                extra_env: dict = None) -> str:
     outdir = os.path.join(tmp, tag)
     os.makedirs(outdir, exist_ok=True)
     base = _worker_env(outdir, nprocs, local_devices)
+    base.update(extra_env or {})
     procs = []
     port = _free_port()
     for rank in range(nprocs):
@@ -113,6 +115,21 @@ def test_multiprocess_metrics_match(runs):
     (res1, _), (res2, _) = runs
     # distributed eval (psum'd metric sums, padding masked) must agree too
     assert res1["best_acc1"] == pytest.approx(res2["best_acc1"], abs=1e-3)
+
+
+def test_multiprocess_windowed_device_data_matches(runs, tmp_path):
+    """steps_per_dispatch>1 with the HBM-resident indexed data path across 2
+    REAL processes == the single-process per-batch run: exercises
+    make_array_from_process_local_data on (K,B) index windows (each process
+    contributes only its sampler shard's indices)."""
+    windowed = run_workers(str(tmp_path), "windowed", nprocs=2,
+                           local_devices=2,
+                           extra_env={"TPU_DIST_TEST_K": "2"})
+    (_, p1), _ = runs  # the fixture's single-process per-batch run
+    _, p2 = _load(windowed)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p2[k], rtol=2e-4, atol=2e-5,
+                                   err_msg=f"leaf {k}")
 
 
 def test_multiprocess_sharded_checkpoint(tmp_path):

@@ -1,11 +1,11 @@
 """Mixture-of-Experts MLP + expert parallelism (GShard/Switch-style).
 
 Absent from the reference (SURVEY.md §2c: EP/MoE ABSENT). TPU-first MoE is
-the GShard dispatch pattern: top-1 (Switch) gating, fixed expert capacity so
-every shape is static, and one-hot dispatch/combine einsums that XLA turns
-into all-to-alls when the expert dimension is sharded over the ``expert``
-mesh axis (tpu_dist.parallel.ep) — no dynamic gather/scatter, no host
-routing.
+the GShard dispatch pattern: top-1 (Switch) or top-2 (GShard) gating, fixed
+expert capacity so every shape is static, and one-hot dispatch/combine
+einsums that XLA turns into all-to-alls when the expert dimension is sharded
+over the ``expert`` mesh axis (tpu_dist.parallel.ep) — no dynamic
+gather/scatter, no host routing.
 
 Load-balancing: the Switch auxiliary loss (fraction-of-tokens x mean-gate
 per expert) is ``sow``n into the 'intermediates' collection under
@@ -23,7 +23,8 @@ import jax.numpy as jnp
 
 
 class MoEMLP(nn.Module):
-    """Switch-style MoE feed-forward: top-1 gate, capacity-bounded dispatch.
+    """MoE feed-forward: top-1 (Switch) or top-2 (GShard) gate,
+    capacity-bounded dispatch.
 
     Input (B, L, D) -> (B, L, D). Expert weights carry a leading experts dim
     sharded over the 'expert' axis by tpu_dist.parallel.ep.ep_param_specs.
