@@ -20,11 +20,15 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 from flax import serialization
+
+_async_writer: Optional[threading.Thread] = None
+_async_error: Optional[BaseException] = None
 
 
 def _to_host(tree):
@@ -56,26 +60,8 @@ def _to_host(tree):
 _MAGIC = b"TPUDIST1\n"
 
 
-def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
-                    arch: str, is_best: bool,
-                    extra_meta: Optional[Dict] = None) -> Optional[str]:
-    """Atomic save; returns path on process 0, None elsewhere.
-
-    For states with cross-host SHARDED leaves, ALL processes must call this
-    (the gather is collective); replicated states save process-0-only.
-    """
-    needs_collective = any(
-        isinstance(x, jax.Array) and not x.is_fully_addressable
-        and not x.is_fully_replicated for x in jax.tree.leaves(state))
-    if jax.process_index() != 0 and not needs_collective:
-        return None  # replicated state: no reason to host-copy it everywhere
-    host_state = _to_host(state)  # collective only for cross-host shards
-    if jax.process_index() != 0:
-        return None
-    os.makedirs(ckpt_dir, exist_ok=True)
-    path = os.path.join(ckpt_dir, f"{arch}-checkpoint.msgpack")
-    meta = {"epoch": epoch, "arch": arch, "best_acc1": float(best_acc1),
-            "step": int(host_state.step), **(extra_meta or {})}
+def _write(ckpt_dir: str, path: str, host_state, meta: Dict,
+           arch: str, is_best: bool) -> None:
     meta_bytes = json.dumps(meta).encode()
     blob = serialization.to_bytes(host_state)
     tmp = path + ".tmp"
@@ -98,6 +84,73 @@ def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
             best = os.path.join(ckpt_dir, dst)
             shutil.copyfile(src, best + ".tmp")
             os.replace(best + ".tmp", best)
+
+
+def wait_for_async_save() -> None:
+    """Block until a pending async write finishes (call before exit/load).
+
+    Re-raises any exception the background writer hit (ENOSPC, permissions)
+    — write failures must stop the run, not rot checkpoints silently.
+    """
+    global _async_writer, _async_error
+    if _async_writer is not None:
+        _async_writer.join()
+        _async_writer = None
+    if _async_error is not None:
+        err, _async_error = _async_error, None
+        raise RuntimeError("async checkpoint write failed") from err
+
+
+# a process must never exit with a write in flight (daemon threads are
+# killed mid-write at interpreter shutdown)
+import atexit  # noqa: E402
+
+atexit.register(wait_for_async_save)
+
+
+def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
+                    arch: str, is_best: bool,
+                    extra_meta: Optional[Dict] = None,
+                    async_write: bool = False) -> Optional[str]:
+    """Atomic save; returns path on process 0, None elsewhere.
+
+    For states with cross-host SHARDED leaves, ALL processes must call this
+    (the gather is collective); replicated states save process-0-only.
+
+    ``async_write=True`` moves serialization + disk I/O to a background
+    thread (the device->host gather stays synchronous — it must read the
+    state before training mutates it). At most one writer is in flight;
+    a second save joins the previous one first, and atomic tmp+rename means
+    a crash mid-write never corrupts the last complete checkpoint. NOTE:
+    the returned path is not valid to read until
+    :func:`wait_for_async_save` returns (which also re-raises writer
+    errors; an atexit hook joins any writer left pending at exit).
+    """
+    needs_collective = any(
+        isinstance(x, jax.Array) and not x.is_fully_addressable
+        and not x.is_fully_replicated for x in jax.tree.leaves(state))
+    if jax.process_index() != 0 and not needs_collective:
+        return None  # replicated state: no reason to host-copy it everywhere
+    host_state = _to_host(state)  # collective only for cross-host shards
+    if jax.process_index() != 0:
+        return None
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, f"{arch}-checkpoint.msgpack")
+    meta = {"epoch": epoch, "arch": arch, "best_acc1": float(best_acc1),
+            "step": int(host_state.step), **(extra_meta or {})}
+    global _async_writer
+    wait_for_async_save()  # serialize writers, surface prior write errors
+    if async_write:
+        def run():
+            global _async_error
+            try:
+                _write(ckpt_dir, path, host_state, meta, arch, is_best)
+            except BaseException as e:  # re-raised by wait_for_async_save
+                _async_error = e
+        _async_writer = threading.Thread(target=run, daemon=True)
+        _async_writer.start()
+    else:
+        _write(ckpt_dir, path, host_state, meta, arch, is_best)
     return path
 
 

@@ -443,6 +443,7 @@ class Trainer:
                      f"{self._epoch_in_progress}; resume with --resume")
             raise
         finally:
+            ckpt.wait_for_async_save()  # never exit with a write in flight
             if profiling:
                 # flush the trace even on OOM/interrupt — a failing run is
                 # exactly the one worth profiling
@@ -473,9 +474,11 @@ class Trainer:
                 # column: train-phase images/sec (tpu_dist extension)
                 with open(csv_path, "a+", newline="") as f:
                     csv.writer(f).writerow([t0, epoch_secs, round(train_ips, 1)])
+            # async: serialization + disk write overlap the next epoch (the
+            # device->host gather stays on the critical path by necessity)
             ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, epoch + 1,
                                  self.best_acc1, cfg.arch, is_best,
-                                 extra_meta=self._run_meta)
+                                 extra_meta=self._run_meta, async_write=True)
             self.log(f"Epoch {epoch}: train_loss={train_metrics['loss']:.4f} "
                      f"val_acc1={acc1 * 100:.3f} best={self.best_acc1 * 100:.3f} "
                      f"({epoch_secs:.1f}s, train {train_ips:,.0f} img/s)")
