@@ -76,6 +76,10 @@ def main():
                     help="checkpoint every N steps (0 = only at end/interrupt)")
     ap.add_argument("--resume", default="",
                     help="checkpoint to resume from (continues at its step)")
+    ap.add_argument("--generate", type=int, default=0,
+                    help="after training, greedy-decode N tokens from the "
+                         "trained model and report how often they follow "
+                         "the synthetic affine rule")
     args = ap.parse_args()
 
     from tpu_dist.parallel import launch
@@ -292,6 +296,32 @@ def main():
     if jax.process_index() == 0:
         print(f"throughput {toks / dt:,.0f} tokens/sec ({mode}, "
               f"{args.steps - timed_from} timed steps)")
+
+    if args.generate:
+        # decode on host-replicated params; the gather is a COLLECTIVE for
+        # cross-host sharded modes, so EVERY process enters it — only the
+        # decode itself is process-0-only. pp's stacked layout is restored
+        # to the dense tree first.
+        from tpu_dist.engine.checkpoint import gather_to_host
+        from tpu_dist.engine.generate import generate
+        host_params = gather_to_host(state.params)
+    if args.generate and jax.process_index() == 0:
+        if use_pp:
+            from tpu_dist.parallel.pp import unstack_pipeline_params
+            host_params = unstack_pipeline_params(host_params)
+        n = min(args.generate, args.seq_len - 2)
+        seed = 3
+        prompt = jnp.asarray([[seed, (seed * 5 + 7) % args.vocab_size]],
+                             jnp.int32)
+        # sp's model closes over mesh axis names (ring attention); decode
+        # with the dense equivalent — same weights, same math
+        gen_model = tiny_lm(**lm_kw) if use_sp else model
+        out = np.asarray(generate(gen_model, host_params, prompt, steps=n))
+        follows = sum(int(out[0, i + 1])
+                      == (int(out[0, i]) * 5 + 7) % args.vocab_size
+                      for i in range(1, n + 1))
+        print(f"generated {n} tokens, {follows}/{n} follow the affine rule: "
+              f"{out[0].tolist()}")
 
 
 if __name__ == "__main__":

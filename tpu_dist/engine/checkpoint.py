@@ -31,7 +31,7 @@ _async_writer: Optional[threading.Thread] = None
 _async_error: Optional[BaseException] = None
 
 
-def _to_host(tree):
+def gather_to_host(tree):
     """Host numpy copy of every leaf, reassembling sharded global arrays.
 
     Replicated leaves — even over a multi-host mesh — read out locally via
@@ -52,6 +52,9 @@ def _to_host(tree):
         return np.asarray(jax.device_get(x))
 
     return jax.tree.map(get, tree)
+
+
+_to_host = gather_to_host  # internal alias
 
 
 # single-file container so blob+meta commit in ONE os.replace (a two-file
