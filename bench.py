@@ -175,18 +175,25 @@ def main():
                     print(f"sweep stem={stem} b={pcb}: failed {e!r}",
                           file=sys.stderr)
 
-    stem = os.environ.get("BENCH_CIFAR_STEM") == "1"
+    kwargs = {}
+    if os.environ.get("BENCH_CIFAR_STEM") == "1":
+        kwargs["cifar_stem"] = True
+    if os.environ.get("BENCH_NORM"):
+        kwargs["norm"] = os.environ["BENCH_NORM"]
     best, rates, window_flops, batch = measure(
-        {"cifar_stem": stem} if stem else {}, per_chip_batch, k, trials)
+        kwargs, per_chip_batch, k, trials)
     ips_per_chip, tflops, mfu, fpi = report("headline", best, rates,
                                             window_flops, batch)
 
-    default_workload = IMG == 32 and NUM_CLASSES == 10
+    default_workload = IMG == 32 and NUM_CLASSES == 10 and not kwargs
     if not default_workload:
-        # a different image size/class count is a different workload: name it
-        # and do NOT compare against the CIFAR baseline number
+        # a different image size/class count/model variant is a different
+        # workload: name it and do NOT compare against the CIFAR baseline
+        variant = "_".join(f"{k}-{v}" for k, v in sorted(kwargs.items()))
         print(json.dumps({
-            "metric": f"resnet50_{IMG}px_images_per_sec_per_chip",
+            "metric": f"resnet50_{IMG}px"
+                      + (f"_{variant}" if variant else "")
+                      + "_images_per_sec_per_chip",
             "value": round(ips_per_chip, 1),
             "unit": "images/sec/chip",
             "vs_baseline": 1.0,

@@ -63,3 +63,19 @@ def test_bf16_model_keeps_fp32_bn_stats():
     assert all(s.dtype == jnp.float32 for s in stats)
     out = m.apply(variables, jnp.zeros((1, 32, 32, 3)), train=False)
     assert out.dtype == jnp.float32  # logits cast back for a stable loss
+
+
+def test_resnet_groupnorm_variant():
+    """norm='gn': no batch_stats collection, train==eval math, runs e2e."""
+    import jax.numpy as jnp
+    from tpu_dist.engine.state import init_model
+    from tpu_dist.models import create_model
+
+    model = create_model("resnet18", num_classes=10, norm="gn")
+    params, stats = init_model(model, jax.random.PRNGKey(0), (2, 32, 32, 3))
+    assert stats == {}  # GroupNorm keeps no running statistics
+    x = jnp.ones((2, 32, 32, 3))
+    out_train = model.apply({"params": params}, x, train=True)
+    out_eval = model.apply({"params": params}, x, train=False)
+    assert out_train.shape == (2, 10)
+    np.testing.assert_array_equal(np.asarray(out_train), np.asarray(out_eval))

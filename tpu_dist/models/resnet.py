@@ -92,12 +92,22 @@ class ResNet(nn.Module):
     num_classes: int = 10
     dtype: jnp.dtype = jnp.float32
     cifar_stem: bool = False
+    norm: str = "bn"  # bn = torchvision parity (SyncBN under jit);
+                      # gn = GroupNorm(32): no running stats / batch coupling
+                      # (identical math at any batch size or replica count)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
-                       epsilon=1e-5, dtype=jnp.float32)  # stats & affine in fp32
+        if self.norm == "gn":
+            norm = partial(nn.GroupNorm, num_groups=32, epsilon=1e-5,
+                           dtype=jnp.float32)
+        elif self.norm == "bn":
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5,
+                           dtype=jnp.float32)  # stats & affine in fp32
+        else:
+            raise ValueError(f"unknown norm {self.norm!r} (bn|gn)")
 
         x = x.astype(self.dtype)
         if self.cifar_stem:
