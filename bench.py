@@ -53,6 +53,7 @@ def peak_tflops_for(device) -> float | None:
 
 
 IMG = int(os.environ.get("BENCH_IMAGE_SIZE", "32"))       # 224 = ImageNet
+ARCH = os.environ.get("BENCH_ARCH", "resnet50")
 NUM_CLASSES = int(os.environ.get("BENCH_NUM_CLASSES", "10"))
 
 
@@ -71,7 +72,7 @@ def build(model_kwargs, batch, k):
     from tpu_dist.parallel.mesh import make_mesh, replicated
 
     mesh = make_mesh()
-    model = create_model("resnet50", num_classes=NUM_CLASSES, dtype=jnp.bfloat16,
+    model = create_model(ARCH, num_classes=NUM_CLASSES, dtype=jnp.bfloat16,
                          **model_kwargs)
     params, batch_stats = init_model(model, jax.random.PRNGKey(0), (2, IMG, IMG, 3))
     tx = make_optimizer(0.1, 0.9, 1e-4, steps_per_epoch=100)
@@ -164,6 +165,9 @@ def main():
         return ips_chip, tflops, mfu, fpi
 
     if os.environ.get("BENCH_SWEEP") == "1":
+        if not ARCH.startswith("resnet"):
+            raise SystemExit("BENCH_SWEEP sweeps ResNet stems; unset "
+                             f"BENCH_ARCH={ARCH}")
         for stem in (False, True):
             for pcb in (1024, 2048, 4096):
                 try:
@@ -178,20 +182,24 @@ def main():
     kwargs = {}
     if os.environ.get("BENCH_CIFAR_STEM") == "1":
         kwargs["cifar_stem"] = True
-    if os.environ.get("BENCH_NORM"):
+    if os.environ.get("BENCH_NORM", "bn") != "bn":  # bn IS the default
         kwargs["norm"] = os.environ["BENCH_NORM"]
+    if kwargs and not ARCH.startswith("resnet"):
+        raise SystemExit(f"BENCH_CIFAR_STEM/BENCH_NORM are ResNet knobs; "
+                         f"unset them with BENCH_ARCH={ARCH}")
     best, rates, window_flops, batch = measure(
         kwargs, per_chip_batch, k, trials)
     ips_per_chip, tflops, mfu, fpi = report("headline", best, rates,
                                             window_flops, batch)
 
-    default_workload = IMG == 32 and NUM_CLASSES == 10 and not kwargs
+    default_workload = (IMG == 32 and NUM_CLASSES == 10 and not kwargs
+                        and ARCH == "resnet50")
     if not default_workload:
         # a different image size/class count/model variant is a different
         # workload: name it and do NOT compare against the CIFAR baseline
         variant = "_".join(f"{k}-{v}" for k, v in sorted(kwargs.items()))
         print(json.dumps({
-            "metric": f"resnet50_{IMG}px"
+            "metric": f"{ARCH}_{IMG}px"
                       + (f"_{variant}" if variant else "")
                       + "_images_per_sec_per_chip",
             "value": round(ips_per_chip, 1),

@@ -19,7 +19,7 @@ from typing import Callable, Dict, Tuple
 
 import jax.numpy as jnp
 
-from tpu_dist.models import lenet, moe, resnet, transformer
+from tpu_dist.models import lenet, moe, resnet, transformer, vit
 
 # name -> (constructor, kind)
 _REGISTRY: Dict[str, Tuple[Callable, str]] = {
@@ -30,6 +30,10 @@ _REGISTRY: Dict[str, Tuple[Callable, str]] = {
     "resnet152": (resnet.ResNet152, "image"),
     "lenet": (lenet.LeNet, "image"),
     "mnist_net": (lenet.LeNet, "image"),  # reference 5.2 'Net' alias
+    "vit_tiny": (vit.ViTTiny, "image"),
+    "vit_small": (vit.ViTSmall, "image"),
+    "vit_base": (vit.ViTBase, "image"),
+    "vit_cifar": (vit.ViTCifar, "image"),
     "transformer_lm": (transformer.TransformerLM, "lm"),
     "tiny_lm": (transformer.tiny_lm, "lm"),
     "moe_lm": (moe.MoETransformerLM, "lm"),

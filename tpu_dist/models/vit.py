@@ -1,0 +1,72 @@
+"""Vision Transformer family (TPU-first image classifier).
+
+Beyond the reference's torchvision-CNN catalog (reference
+1.dataparallel.py:23-24): on TPU the ResNet family tops out around ~25% MFU
+at CIFAR/ImageNet shapes (BASELINE.md norm/stem experiments — the conv
+stack underfills the MXU), while a ViT is matmuls end to end. Same Trainer,
+same data pipeline, same `--arch` UX.
+
+Design:
+* patchify = one strided Conv (the standard trick; XLA lowers it to a
+  matmul over unfolded patches), learned positional embeddings, a learned
+  [CLS] token read out by the head;
+* reuses tpu_dist.models.transformer.Block (pre-LN, pluggable attn_fn) —
+  non-causal full attention here;
+* fp32 LayerNorm/softmax/logits regardless of compute dtype, matching the
+  family-wide precision policy.
+
+vit_tiny/16 etc. follow the standard depth/width/heads plans; `patch_size`
+defaults suit 224px inputs — `vit_cifar` uses 4px patches so 32px inputs
+give 8x8=64 tokens.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpu_dist.models.transformer import Block, full_attention
+
+
+class ViT(nn.Module):
+    num_classes: int = 10
+    patch_size: int = 16
+    num_layers: int = 12
+    d_model: int = 192
+    num_heads: int = 3
+    dtype: jnp.dtype = jnp.float32
+    attn_fn: Callable = partial(full_attention, causal=False)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, h, w, c = x.shape
+        p = self.patch_size
+        if h % p or w % p:
+            raise ValueError(f"image {h}x{w} not divisible by patch {p}")
+        x = nn.Conv(self.d_model, (p, p), strides=(p, p), dtype=self.dtype,
+                    name="patch_embed")(x.astype(self.dtype))
+        x = x.reshape(b, -1, self.d_model)               # (B, T, D)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.d_model))
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.d_model))
+                             .astype(self.dtype), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.d_model))
+        x = x + pos.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = Block(self.num_heads, self.dtype, self.attn_fn,
+                      name=f"block{i}")(x, train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          name="head")(x[:, 0])
+        return logits.astype(jnp.float32)
+
+
+# standard plans (depth, width, heads); patch size overridable per call
+ViTTiny = partial(ViT, num_layers=12, d_model=192, num_heads=3)
+ViTSmall = partial(ViT, num_layers=12, d_model=384, num_heads=6)
+ViTBase = partial(ViT, num_layers=12, d_model=768, num_heads=12)
+# CIFAR-native: 4px patches -> 64 tokens from a 32px image
+ViTCifar = partial(ViT, patch_size=4, num_layers=8, d_model=256, num_heads=8)
