@@ -182,8 +182,8 @@ def main():
     kwargs = {}
     if os.environ.get("BENCH_CIFAR_STEM") == "1":
         kwargs["cifar_stem"] = True
-    if os.environ.get("BENCH_NORM", "bn") != "bn":  # bn IS the default
-        kwargs["norm"] = os.environ["BENCH_NORM"]
+    if os.environ.get("BENCH_NORM") and os.environ["BENCH_NORM"] != "bn":
+        kwargs["norm"] = os.environ["BENCH_NORM"]  # bn/empty = default
     if kwargs and not ARCH.startswith("resnet"):
         raise SystemExit(f"BENCH_CIFAR_STEM/BENCH_NORM are ResNet knobs; "
                          f"unset them with BENCH_ARCH={ARCH}")

@@ -314,9 +314,11 @@ def main():
         prompt = jnp.asarray([[seed, (seed * 5 + 7) % args.vocab_size]],
                              jnp.int32)
         # sp's model closes over mesh axis names (ring attention); decode
-        # with the dense equivalent — same weights, same math
+        # with the dense equivalent — same weights, same math. Dense models
+        # decode through the KV cache; MoE uses full recompute.
         gen_model = tiny_lm(**lm_kw) if use_sp else model
-        out = np.asarray(generate(gen_model, host_params, prompt, steps=n))
+        out = np.asarray(generate(gen_model, host_params, prompt, steps=n,
+                                  use_cache=not args.num_experts))
         follows = sum(int(out[0, i + 1])
                       == (int(out[0, i]) * 5 + 7) % args.vocab_size
                       for i in range(1, n + 1))

@@ -66,6 +66,9 @@ def test_trained_lm_generates_the_learned_rule():
     key = jax.random.PRNGKey(1)
     for _ in range(60):
         state, _ = step(state, di, dt, key)
+        # keep the async dispatch queue bounded: a 60-deep unfetched queue
+        # intermittently SIGABRTs the virtual-device CPU backend
+        jax.block_until_ready(state.step)
 
     prompt = jnp.asarray([[3, (3 * 5 + 7) % V]], jnp.int32)
     out = np.asarray(generate(lm, jax.device_get(state.params), prompt,
@@ -73,3 +76,25 @@ def test_trained_lm_generates_the_learned_rule():
     follows = sum(int(out[0, i + 1]) == (int(out[0, i]) * 5 + 7) % V
                   for i in range(1, 17))
     assert follows >= 13, (follows, out)
+
+
+def test_cached_decode_matches_full_recompute():
+    """KV-cache decode produces the SAME greedy continuation as the
+    full-recompute path (the cache is an optimization, not a model change)."""
+    lm, params = _lm_and_params(seed=4)
+    prompt = jnp.asarray([[1, 9, 17, 25], [2, 4, 8, 16]], jnp.int32)
+    full = generate(lm, params, prompt, steps=10)
+    cached = generate(lm, params, prompt, steps=10, use_cache=True)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+
+def test_cached_decode_matches_sampling_stream():
+    """Same rng + temperature > 0: cached and full paths sample the SAME
+    tokens (the cache must not perturb the rng stream)."""
+    lm, params = _lm_and_params(seed=5)
+    prompt = jnp.asarray([[7, 3, 11, 2]], jnp.int32)
+    key = jax.random.PRNGKey(42)
+    full = generate(lm, params, prompt, steps=8, temperature=0.8, rng=key)
+    cached = generate(lm, params, prompt, steps=8, temperature=0.8, rng=key,
+                      use_cache=True)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))

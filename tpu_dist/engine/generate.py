@@ -23,20 +23,73 @@ import jax
 import jax.numpy as jnp
 
 
+def _sample(nxt_logits, temperature, rng):
+    if temperature > 0.0:
+        rng, sub = jax.random.split(rng)
+        return jax.random.categorical(sub, nxt_logits / temperature), rng
+    return jnp.argmax(nxt_logits, axis=-1), rng
+
+
 def generate(model, params, prompt: jax.Array, steps: int,
              temperature: float = 0.0,
-             rng: Optional[jax.Array] = None) -> jax.Array:
+             rng: Optional[jax.Array] = None,
+             use_cache: bool = False) -> jax.Array:
     """Continue ``prompt`` (B, P) int32 by ``steps`` tokens.
 
     temperature 0 = greedy argmax (deterministic); > 0 = categorical over
     logits/temperature. Returns the full (B, P+steps) buffer. P+steps must
     not exceed the model's max_len.
+
+    ``use_cache=True`` decodes through the model's per-block KV cache
+    (TransformerLM ``decode=True``): each tick embeds ONE token and attends
+    over the cached keys/values — O(L·d) per token instead of the
+    full-recompute path's O(L²·d). Requires a cache-capable model (the
+    dense TransformerLM; MoE models use the default full-recompute path).
     """
     b, p = prompt.shape
     total = p + steps
     if rng is None:
         rng = jax.random.PRNGKey(0)
     buf = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
+
+    if use_cache:
+        # allocate per-block caches at full length — shapes only, no init
+        # forward pass and no throwaway parameter allocation
+        shapes = jax.eval_shape(
+            lambda: model.init({"params": jax.random.PRNGKey(0)},
+                               jnp.zeros((b, total), jnp.int32), train=False,
+                               decode=True))["cache"]
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+        @jax.jit
+        def decode(params, cache, buf, rng):
+            def tick(carry, pos):
+                buf, cache, rng = carry
+                tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
+                logits, muts = model.apply(
+                    {"params": params, "cache": cache}, tok, train=False,
+                    pos_offset=pos, decode=True, mutable=["cache"])
+                # consume rng ONLY on generating ticks, so the sample
+                # stream matches the full-recompute path exactly
+                generating = pos + 1 >= p
+                if temperature > 0.0:
+                    nxt, rng = jax.lax.cond(
+                        generating,
+                        lambda r: _sample(logits[:, 0], temperature, r),
+                        lambda r: (jnp.zeros((b,), jnp.int32), r), rng)
+                else:
+                    nxt = jnp.argmax(logits[:, 0], axis=-1)
+                cur = jax.lax.dynamic_slice(buf, (0, pos + 1), (b, 1))[:, 0]
+                tok_next = jnp.where(generating, nxt.astype(jnp.int32), cur)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, tok_next[:, None], (0, pos + 1))
+                return (buf, muts["cache"], rng), None
+
+            (buf, _, _), _ = jax.lax.scan(
+                tick, (buf, cache, rng), jnp.arange(0, total - 1))
+            return buf
+
+        return decode(params, cache, buf, rng)
 
     @jax.jit
     def decode(params, buf, rng):
@@ -46,11 +99,7 @@ def generate(model, params, prompt: jax.Array, steps: int,
             nxt_logits = jnp.take_along_axis(
                 logits, pos[None, None, None].astype(jnp.int32)
                 .repeat(b, 0), axis=1)[:, 0]          # (B, V) at position pos
-            if temperature > 0.0:
-                rng, sub = jax.random.split(rng)
-                tok = jax.random.categorical(sub, nxt_logits / temperature)
-            else:
-                tok = jnp.argmax(nxt_logits, axis=-1)
+            tok, rng = _sample(nxt_logits, temperature, rng)
             buf = jax.lax.dynamic_update_slice(
                 buf, tok[:, None].astype(jnp.int32), (0, pos + 1))
             return (buf, rng), tok

@@ -52,7 +52,7 @@ class Block(nn.Module):
     attn_fn: Callable = full_attention
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool = True, decode: bool = False):
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
@@ -60,7 +60,36 @@ class Block(nn.Module):
                        name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shp = (x.shape[0], x.shape[1], self.num_heads, head_dim)
-        out = self.attn_fn(q.reshape(shp), k.reshape(shp), v.reshape(shp))
+        q, k, v = q.reshape(shp), k.reshape(shp), v.reshape(shp)
+        if decode:
+            # KV cache (standard flax decode pattern): allocated at init
+            # time from the full-length input, then one position written per
+            # step. Attention runs over the whole buffer with the causal
+            # mask hiding positions > cache_index (they are zeros anyway).
+            is_init = self.has_variable("cache", "cached_k")
+            ck = self.variable("cache", "cached_k", jnp.zeros, k.shape,
+                               self.dtype)
+            cv = self.variable("cache", "cached_v", jnp.zeros, v.shape,
+                               self.dtype)
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            if is_init:
+                idx = ci.value
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(self.dtype), (0, idx, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(self.dtype), (0, idx, 0, 0))
+                ci.value = idx + q.shape[1]
+                # decode always uses exact full attention over the cache:
+                # the attn_fn plug-in (flash/blockwise/ring) exists for
+                # TRAINING-time memory, and flash's custom_vjp can't take
+                # the traced cache index as its static offset anyway
+                out = full_attention(q, ck.value, cv.value,
+                                     q_offset=idx, kv_offset=0)
+            else:
+                out = self.attn_fn(q, k, v)
+        else:
+            out = self.attn_fn(q, k, v)
         out = out.reshape(x.shape)
         x = x + nn.Dense(d_model, use_bias=False, dtype=self.dtype,
                          name="proj")(out)
@@ -86,20 +115,22 @@ class TransformerLM(nn.Module):
                          # HBM — the long-context memory lever
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True, pos_offset=0):
+    def __call__(self, tokens, train: bool = True, pos_offset=0,
+                 decode: bool = False):
         # pos_offset: global position of this shard's first token (sequence
         # parallelism passes axis_index * shard_len, a traced scalar; 0 when
-        # the sequence axis is unsharded)
+        # the sequence axis is unsharded). decode=True enables the per-block
+        # KV cache ('cache' collection) for autoregressive generation.
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                      name="tok_emb")(tokens)
         pos = pos_offset + jnp.arange(tokens.shape[1])
         x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
                          name="pos_emb")(pos)[None]
-        block_cls = (nn.remat(Block, static_argnums=(2,)) if self.remat
+        block_cls = (nn.remat(Block, static_argnums=(2, 3)) if self.remat
                      else Block)
         for i in range(self.num_layers):
             x = block_cls(self.num_heads, self.dtype, self.attn_fn,
-                          name=f"block{i}")(x, train)
+                          name=f"block{i}")(x, train, decode)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                           name="lm_head")(x)
