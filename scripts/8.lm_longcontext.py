@@ -76,6 +76,10 @@ def main():
                     help="checkpoint every N steps (0 = only at end/interrupt)")
     ap.add_argument("--resume", default="",
                     help="checkpoint to resume from (continues at its step)")
+    ap.add_argument("--eval-size", type=int, default=0,
+                    help="hold out N sequences (same distribution, fresh "
+                         "seed) and report val loss/perplexity at every "
+                         "print and at the end (dense-mesh modes)")
     ap.add_argument("--generate", type=int, default=0,
                     help="after training, greedy-decode N tokens from the "
                          "trained model and report how often they follow "
@@ -225,18 +229,47 @@ def main():
     state = place(state)
 
     # synthetic affine-rule token stream (learnable, deterministic)
-    rng = np.random.default_rng(0)
-    start = rng.integers(0, args.vocab_size, (args.batch_size, 1))
-    rows = [start]
-    for _ in range(args.seq_len):
-        nxt = (rows[-1] * 5 + 7) % args.vocab_size
-        flip = rng.random(nxt.shape) < 0.05
-        rows.append(np.where(flip, rng.integers(0, args.vocab_size, nxt.shape), nxt))
-    tokens = np.concatenate(rows, axis=1).astype(np.int32)
-    inputs, targets = make_lm_batches(tokens)
+    def affine_stream(n_rows, seed):
+        rng = np.random.default_rng(seed)
+        start = rng.integers(0, args.vocab_size, (n_rows, 1))
+        rows = [start]
+        for _ in range(args.seq_len):
+            nxt = (rows[-1] * 5 + 7) % args.vocab_size
+            flip = rng.random(nxt.shape) < 0.05
+            rows.append(np.where(flip,
+                                 rng.integers(0, args.vocab_size, nxt.shape),
+                                 nxt))
+        return np.concatenate(rows, axis=1).astype(np.int32)
+
+    inputs, targets = make_lm_batches(affine_stream(args.batch_size, seed=0))
     sh = NamedSharding(mesh, data_spec)
     inputs = jax.device_put(inputs, sh)
     targets = jax.device_put(targets, sh)
+
+    eval_step = None
+    if args.eval_size:
+        if use_sp or use_pp:
+            raise SystemExit("--eval-size supports the dense-mesh modes "
+                             "(dp/fsdp/tp/ep); sp/pp evaluate via their "
+                             "train-loss curves")
+        if args.eval_size % mesh.shape["data"]:
+            raise SystemExit(f"--eval-size {args.eval_size} must divide by "
+                             f"the data axis ({mesh.shape['data']})")
+        from tpu_dist.engine.lm_steps import make_lm_eval_step
+        eval_step = make_lm_eval_step(model, mesh)
+        vi, vt = make_lm_batches(affine_stream(args.eval_size, seed=1))
+        vi = jax.device_put(vi, sh)
+        vt = jax.device_put(vt, sh)
+
+        eval_secs = [0.0]  # excluded from the throughput window
+
+        def evaluate(st):
+            t = time.perf_counter()
+            m = jax.device_get(eval_step(st.params, vi, vt))
+            eval_secs[0] += time.perf_counter() - t
+            loss = float(m["loss_sum"]) / float(m["count"])
+            return loss, float(np.exp(min(loss, 30.0))), \
+                float(m["correct1"]) / float(m["count"])
 
     mode = ("pp-gpipe" if use_pp else
             "sp-ring" if use_sp else
@@ -273,7 +306,13 @@ def main():
                 m = jax.device_get(metrics)
                 loss = float(m["loss_sum"]) / float(m["count"])
                 acc = float(m["correct1"]) / float(m["count"])
-                if jax.process_index() == 0:
+                if eval_step is not None:
+                    vl, ppl, va = evaluate(state)
+                    if jax.process_index() == 0:
+                        print(f"step {i:4d} loss {loss:.4f} acc {acc:.3f} | "
+                              f"val_loss {vl:.4f} ppl {ppl:.2f} "
+                              f"val_acc {va:.3f}")
+                elif jax.process_index() == 0:
                     print(f"step {i:4d} loss {loss:.4f} acc {acc:.3f}")
             if args.save_freq and (i + 1) % args.save_freq == 0:
                 save(state, i + 1)
@@ -291,6 +330,8 @@ def main():
         raise
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
+    if eval_step is not None:
+        dt -= eval_secs[0]  # eval (incl. its compile) is not training time
     save(state, args.steps)
     toks = (args.steps - timed_from) * args.batch_size * args.seq_len
     if jax.process_index() == 0:

@@ -152,3 +152,30 @@ def test_fsdp_matches_dp_and_stays_sharded(setup):
     # small leaves (norm scales) stay replicated by the min_size rule
     specs = fsdp_specs({"tiny": np.zeros((8,))}, 8)
     assert specs["tiny"] == P()
+
+
+def test_lm_eval_step_exact_metrics():
+    """Eval metric sums equal a hand-computed forward (counts, not means)."""
+    import numpy as np
+    from tpu_dist.engine.lm_steps import (lm_loss_and_metrics,
+                                          make_lm_batches, make_lm_eval_step)
+    from tpu_dist.models.transformer import tiny_lm
+    from tpu_dist.parallel.mesh import make_mesh
+
+    lm = tiny_lm(vocab_size=32, num_layers=1, d_model=32, num_heads=2,
+                 max_len=16)
+    params = lm.init({"params": jax.random.PRNGKey(0)},
+                     jnp.zeros((1, 16), jnp.int32), train=False)["params"]
+    tokens = np.random.default_rng(0).integers(0, 32, (8, 17)).astype(np.int32)
+    inputs, targets = make_lm_batches(tokens)
+    mesh = make_mesh((8,), ("data",))
+    step = make_lm_eval_step(lm, mesh)
+    m = jax.device_get(step(params, jnp.asarray(inputs), jnp.asarray(targets)))
+
+    logits = lm.apply({"params": params}, jnp.asarray(inputs), train=False)
+    _, ref = lm_loss_and_metrics(logits, jnp.asarray(targets),
+                                 jnp.ones(targets.shape, jnp.float32))
+    assert float(m["count"]) == targets.size
+    assert float(m["loss_sum"]) == pytest.approx(float(ref["loss_sum"]),
+                                                 rel=1e-5)
+    assert float(m["correct1"]) == float(ref["correct1"])

@@ -108,6 +108,23 @@ def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
                    donate_argnums=(0,) if donate else ())
 
 
+def make_lm_eval_step(model, mesh: Mesh, data_axis: str = DATA_AXIS,
+                      ) -> Callable:
+    """Forward-only metric sums on a held-out shard: (params, inputs,
+    targets) -> {loss_sum, correct1, count}. Works for any GSPMD placement
+    the params carry (dp / fsdp / tp / ep), like make_lm_train_step."""
+    batch_sh = NamedSharding(mesh, P(data_axis))
+
+    def step(params, inputs, targets):
+        logits = model.apply({"params": params}, inputs, train=False)
+        mask = jnp.ones(targets.shape, jnp.float32)
+        _, metrics = lm_loss_and_metrics(logits, targets, mask)
+        return metrics
+
+    return jax.jit(step, in_shardings=(None, batch_sh, batch_sh),
+                   out_shardings=NamedSharding(mesh, P()))
+
+
 def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,
                           data_axis: str = DATA_AXIS,
                           seq_axis: str = SEQ_AXIS,

@@ -75,10 +75,11 @@ class Block(nn.Module):
                                lambda: jnp.zeros((), jnp.int32))
             if is_init:
                 idx = ci.value
+                z = jnp.zeros((), idx.dtype)  # match idx dtype (x64-safe)
                 ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, k.astype(self.dtype), (0, idx, 0, 0))
+                    ck.value, k.astype(self.dtype), (z, idx, z, z))
                 cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, v.astype(self.dtype), (0, idx, 0, 0))
+                    cv.value, v.astype(self.dtype), (z, idx, z, z))
                 ci.value = idx + q.shape[1]
                 # decode always uses exact full attention over the cache:
                 # the attn_fn plug-in (flash/blockwise/ring) exists for
