@@ -91,9 +91,12 @@ def test_script_8_lm(tmp_path):
     out = run_script(tmp_path, "8.lm_longcontext.py",
                      ["--steps", "3", "--batch-size", "4", "--seq-len", "32",
                       "--d-model", "32", "--num-layers", "1", "--num-heads",
-                      "2", "--print-freq", "1",
+                      "2", "--print-freq", "1", "--eval-size", "4",
+                      "--generate", "8",
                       "--checkpoint-dir", os.path.join(str(tmp_path), "ck")])
     assert "throughput" in out
+    assert "ppl" in out            # --eval-size surface
+    assert "affine rule" in out    # --generate surface
 
 
 def test_script_8_lm_pipeline_mode(tmp_path):
