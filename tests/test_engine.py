@@ -350,3 +350,23 @@ def test_trainer_windowed_mid_epoch_resume_step_exact(tmp_path):
     p_res = np.concatenate([np.asarray(jax.device_get(x)).ravel()
                             for x in jax.tree.leaves(tr_res.state.params)])
     np.testing.assert_allclose(p_full, p_res, rtol=1e-5, atol=1e-7)
+
+
+def test_windowed_eval_matches_host_eval(tmp_path):
+    """One-dispatch HBM-resident eval == the host-fed per-batch eval,
+    including sampler-padding masking (exact sums both ways)."""
+    from tpu_dist.configs import TrainConfig
+    from tpu_dist.engine import Trainer
+
+    cfg = TrainConfig(dataset="synthetic-mnist", arch="lenet", epochs=1,
+                      batch_size=64, synth_train_size=256,
+                      synth_val_size=150,  # NOT a batch multiple: padding
+                      seed=2, print_freq=100, steps_per_dispatch=4,
+                      checkpoint_dir=str(tmp_path))
+    tr = Trainer(cfg)
+    assert tr._val_data_dev is not None
+    tr.train_epoch(0)
+    acc_dev = tr.validate(0)
+    tr._val_data_dev = None  # force the host-fed path on the same state
+    acc_host = tr.validate(0)
+    assert acc_dev == acc_host

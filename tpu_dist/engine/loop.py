@@ -149,19 +149,25 @@ class Trainer:
             # shard_map config through it would silently drop grad
             # compression/predivide and per-replica BN semantics
             raise ValueError("data_placement='device' requires variant='jit'")
-        fits_hbm = (in_memory and self.train_ds.images.nbytes
+        # budget covers BOTH splits — the val set rides along into HBM
+        data_bytes = (self.train_ds.images.nbytes
+                      + getattr(getattr(self.val_ds, "images", None),
+                                "nbytes", 0)) if in_memory else 0
+        fits_hbm = (in_memory and data_bytes
                     <= int(os.environ.get("TPU_DIST_DEVICE_DATA_MAX",
                                           str(1 << 30))))
         self.device_data = (cfg.data_placement == "device" or
                             (cfg.data_placement == "auto" and fits_hbm
                              and self.k > 1))
         self._train_data_dev = None
+        self._val_data_dev = None
         self._prefetched_windows = None  # (epoch, [(n, device idx window)])
         if self.device_data:
             # whole training set resident in HBM (rows packed into i32 words
             # for native 32-bit gathers), replicated per chip; per-step
             # batches are gathered on device from an index window
-            from tpu_dist.engine.steps import pack_images_for_device
+            from tpu_dist.engine.steps import (make_indexed_eval_step,
+                                               pack_images_for_device)
             self._train_data_dev = (
                 jax.device_put(pack_images_for_device(self.train_ds.images),
                                replicated(self.mesh)),
@@ -170,6 +176,18 @@ class Trainer:
             self.window_step = make_indexed_multi_train_step(
                 self.model, self.tx, self.transform, self.mesh,
                 self.train_ds.image_shape)
+            # the val set rides along in HBM too (same placement rules):
+            # the whole distributed eval becomes ONE dispatch per epoch
+            if isinstance(getattr(self.val_ds, "images", None), np.ndarray) \
+                    and self.val_ds.image_shape == self.train_ds.image_shape:
+                self._val_data_dev = (
+                    jax.device_put(pack_images_for_device(self.val_ds.images),
+                                   replicated(self.mesh)),
+                    jax.device_put(self.val_ds.labels.astype(np.int32),
+                                   replicated(self.mesh)))
+                self.window_eval_step = make_indexed_eval_step(
+                    self.model, eval_transform, self.mesh,
+                    self.val_ds.image_shape)
         elif self.k > 1:
             self.window_step = make_multi_train_step(
                 self.model, self.tx, self.transform, self.mesh)
@@ -397,17 +415,39 @@ class Trainer:
         """Distributed eval (C15): metric sums psum'd across replicas, padding
         masked out, exact division by the true sample count. device_get
         happens ONCE after the loop so eval batches pipeline (async dispatch),
-        unlike the reference's per-batch barrier+allreduce."""
-        loader = self._loader(self.val_ds, False, epoch)
-        pending = []
-        it = prefetch_to_device(iter(loader), self.batch_sharding)
-        for images, labels, valid in it:
-            pending.append(self.eval_step(
-                self.state.params, self.state.batch_stats, images, labels, valid))
-        sums = {"loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0, "count": 0.0}
-        for m in jax.device_get(pending):
-            for k in sums:
-                sums[k] += float(m[k])
+        unlike the reference's per-batch barrier+allreduce. With an
+        HBM-resident val set the whole eval is ONE dispatch."""
+        if self._val_data_dev is not None:
+            sampler = self._sampler(self.val_ds, False, epoch)
+            idx, valid = sampler.indices_with_valid()
+            nb = sampler.num_samples // self.local_batch
+            n = nb * self.local_batch
+            shape = (nb, self.local_batch)
+            win_sh = NamedSharding(self.mesh, P(None, "data"))
+            idx_d = assemble_global(
+                win_sh, np.ascontiguousarray(
+                    np.asarray(idx[:n], np.int32).reshape(shape)))
+            valid_d = assemble_global(
+                win_sh, np.ascontiguousarray(
+                    np.asarray(valid[:n], np.float32).reshape(shape)))
+            m = jax.device_get(self.window_eval_step(
+                self.state.params, self.state.batch_stats,
+                *self._val_data_dev, idx_d, valid_d))
+            sums = {k: float(m[k]) for k in
+                    ("loss_sum", "correct1", "correct5", "count")}
+        else:
+            loader = self._loader(self.val_ds, False, epoch)
+            pending = []
+            it = prefetch_to_device(iter(loader), self.batch_sharding)
+            for images, labels, valid in it:
+                pending.append(self.eval_step(
+                    self.state.params, self.state.batch_stats, images, labels,
+                    valid))
+            sums = {"loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0,
+                    "count": 0.0}
+            for m in jax.device_get(pending):
+                for k in sums:
+                    sums[k] += float(m[k])
         n = max(sums["count"], 1.0)
         acc1 = sums["correct1"] / n
         acc5 = sums["correct5"] / n

@@ -228,6 +228,45 @@ def make_indexed_multi_train_step(model, tx, transform, mesh: Mesh,
                    donate_argnums=(0,) if donate else ())
 
 
+def make_indexed_eval_step(model, transform, mesh: Mesh, image_shape,
+                           data_axis: str = DATA_AXIS) -> Callable:
+    """Whole-validation-set eval in ONE dispatch from HBM-resident data.
+
+    signature: (params, batch_stats, images_all (packed, REPLICATED),
+    labels_all, idx (K,B) i32 sharded (None, data), valid (K,B) f32 same
+    sharding) -> summed metrics over all K batches. The companion of
+    :func:`make_indexed_multi_train_step` for the eval loop: sampler padding
+    is masked per sample via ``valid`` exactly like the host-fed
+    :func:`make_eval_step`.
+    """
+    h, w, c = image_shape
+    repl = NamedSharding(mesh, P())
+    idx_sh = NamedSharding(mesh, P(None, data_axis))
+
+    def step(params, batch_stats, images_all, labels_all, idx, valid):
+        def body(sums, blk):
+            idx_b, valid_b = blk
+            rows = jnp.take(images_all, idx_b, axis=0)
+            if rows.dtype == jnp.int32:
+                rows = jax.lax.bitcast_convert_type(rows, jnp.uint8)
+            x = transform(rows.reshape(-1, h, w, c), None)
+            labels = jnp.take(labels_all, idx_b, axis=0)
+            logits = model.apply({"params": params,
+                                  "batch_stats": batch_stats}, x, train=False)
+            m = _metric_sums(logits, labels,
+                             cross_entropy_sum(logits, labels, valid_b),
+                             valid_b)
+            return jax.tree.map(jnp.add, sums, m), None
+
+        zeros = {k: jnp.float32(0.0)
+                 for k in ("loss_sum", "correct1", "correct5", "count")}
+        sums, _ = jax.lax.scan(body, zeros, (idx, valid))
+        return sums
+
+    return jax.jit(step, in_shardings=(None, None, repl, repl, idx_sh, idx_sh),
+                   out_shardings=repl)
+
+
 def make_eval_step(model, transform, mesh: Mesh,
                    data_axis: str = DATA_AXIS) -> Callable:
     """Distributed eval step (C15): metric sums on the global sharded batch."""
