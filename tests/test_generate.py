@@ -98,3 +98,29 @@ def test_cached_decode_matches_sampling_stream():
     cached = generate(lm, params, prompt, steps=8, temperature=0.8, rng=key,
                       use_cache=True)
     np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+
+def test_top_k_restricts_to_best_tokens():
+    """top_k=1 sampling == greedy argmax regardless of temperature/rng."""
+    lm, params = _lm_and_params(seed=6)
+    prompt = jnp.asarray([[5, 9]], jnp.int32)
+    greedy = generate(lm, params, prompt, steps=8)
+    k1 = generate(lm, params, prompt, steps=8, temperature=2.0, top_k=1,
+                  rng=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_top_p_nucleus_keeps_valid_tokens():
+    """top_p sampling only ever emits tokens inside the nucleus: with a
+    peaked distribution and small p, it matches greedy."""
+    lm, params = _lm_and_params(seed=7)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    # temperature -> 0+ peaks the distribution so the nucleus is one token
+    greedy = generate(lm, params, prompt, steps=6)
+    p_small = generate(lm, params, prompt, steps=6, temperature=0.05,
+                       top_p=0.5, rng=jax.random.PRNGKey(9))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p_small))
+    # and a permissive nucleus still emits in-vocab tokens
+    out = generate(lm, params, prompt, steps=6, temperature=1.0, top_p=0.9,
+                   rng=jax.random.PRNGKey(4), use_cache=True)
+    assert int(jnp.min(out)) >= 0 and int(jnp.max(out)) < V

@@ -23,22 +23,39 @@ import jax
 import jax.numpy as jnp
 
 
-def _sample(nxt_logits, temperature, rng):
-    if temperature > 0.0:
-        rng, sub = jax.random.split(rng)
-        return jax.random.categorical(sub, nxt_logits / temperature), rng
-    return jnp.argmax(nxt_logits, axis=-1), rng
+def _sample(nxt_logits, temperature, rng, top_k=0, top_p=0.0):
+    if temperature <= 0.0:
+        return jnp.argmax(nxt_logits, axis=-1), rng
+    logits = nxt_logits / temperature
+    if top_k:
+        # keep the k best logits per row, mask the rest (static k)
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if top_p > 0.0:
+        # nucleus: smallest prefix of the sorted distribution with mass >=
+        # top_p stays; everything after it is masked
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = cum - probs < top_p  # first token always kept
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                         axis=-1)[:, None]
+        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+    rng, sub = jax.random.split(rng)
+    return jax.random.categorical(sub, logits), rng
 
 
 def generate(model, params, prompt: jax.Array, steps: int,
              temperature: float = 0.0,
              rng: Optional[jax.Array] = None,
-             use_cache: bool = False) -> jax.Array:
+             use_cache: bool = False,
+             top_k: int = 0, top_p: float = 0.0) -> jax.Array:
     """Continue ``prompt`` (B, P) int32 by ``steps`` tokens.
 
     temperature 0 = greedy argmax (deterministic); > 0 = categorical over
-    logits/temperature. Returns the full (B, P+steps) buffer. P+steps must
-    not exceed the model's max_len.
+    logits/temperature, optionally truncated to the ``top_k`` best tokens
+    and/or the ``top_p`` nucleus. Returns the full (B, P+steps) buffer.
+    P+steps must not exceed the model's max_len.
 
     ``use_cache=True`` decodes through the model's per-block KV cache
     (TransformerLM ``decode=True``): each tick embeds ONE token and attends
@@ -75,7 +92,8 @@ def generate(model, params, prompt: jax.Array, steps: int,
                 if temperature > 0.0:
                     nxt, rng = jax.lax.cond(
                         generating,
-                        lambda r: _sample(logits[:, 0], temperature, r),
+                        lambda r: _sample(logits[:, 0], temperature, r,
+                                          top_k, top_p),
                         lambda r: (jnp.zeros((b,), jnp.int32), r), rng)
                 else:
                     nxt = jnp.argmax(logits[:, 0], axis=-1)
@@ -99,7 +117,7 @@ def generate(model, params, prompt: jax.Array, steps: int,
             nxt_logits = jnp.take_along_axis(
                 logits, pos[None, None, None].astype(jnp.int32)
                 .repeat(b, 0), axis=1)[:, 0]          # (B, V) at position pos
-            tok, rng = _sample(nxt_logits, temperature, rng)
+            tok, rng = _sample(nxt_logits, temperature, rng, top_k, top_p)
             buf = jax.lax.dynamic_update_slice(
                 buf, tok[:, None].astype(jnp.int32), (0, pos + 1))
             return (buf, rng), tok

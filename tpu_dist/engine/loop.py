@@ -149,10 +149,15 @@ class Trainer:
             # shard_map config through it would silently drop grad
             # compression/predivide and per-replica BN semantics
             raise ValueError("data_placement='device' requires variant='jit'")
-        # budget covers BOTH splits — the val set rides along into HBM
+        # budget covers BOTH splits when the val set can ride along into HBM
+        # (in-memory, same image shape — the upload gate below)
+        val_rides = (in_memory and
+                     isinstance(getattr(self.val_ds, "images", None),
+                                np.ndarray)
+                     and self.val_ds.image_shape == self.train_ds.image_shape)
         data_bytes = (self.train_ds.images.nbytes
-                      + getattr(getattr(self.val_ds, "images", None),
-                                "nbytes", 0)) if in_memory else 0
+                      + (self.val_ds.images.nbytes if val_rides else 0)
+                      ) if in_memory else 0
         fits_hbm = (in_memory and data_bytes
                     <= int(os.environ.get("TPU_DIST_DEVICE_DATA_MAX",
                                           str(1 << 30))))
@@ -178,8 +183,7 @@ class Trainer:
                 self.train_ds.image_shape)
             # the val set rides along in HBM too (same placement rules):
             # the whole distributed eval becomes ONE dispatch per epoch
-            if isinstance(getattr(self.val_ds, "images", None), np.ndarray) \
-                    and self.val_ds.image_shape == self.train_ds.image_shape:
+            if val_rides:
                 self._val_data_dev = (
                     jax.device_put(pack_images_for_device(self.val_ds.images),
                                    replicated(self.mesh)),
@@ -339,16 +343,26 @@ class Trainer:
             lbls = np.stack([b[1] for b in stack])
             yield len(stack), (imgs, lbls)
 
+    def _epoch_indices(self, ds, train: bool, epoch: int):
+        """THE sampler->(nb, local_batch) index layout shared by the windowed
+        train path and the one-dispatch eval (they must never diverge: the
+        sampler's batch-blocked ordering is load-bearing for N-process
+        bit-exactness). Returns (idx (nb,B) i32, valid (nb,B) f32)."""
+        sampler = self._sampler(ds, train, epoch)
+        idx, valid = sampler.indices_with_valid()
+        nb = sampler.num_samples // self.local_batch
+        n = nb * self.local_batch
+        shape = (nb, self.local_batch)
+        return (np.asarray(idx[:n], np.int32).reshape(shape),
+                np.asarray(valid[:n], np.float32).reshape(shape))
+
     def _device_windows(self, epoch: int, skip: int, put):
         """(K,B) index windows for the HBM-resident dataset, already ON
         device. The transfers are dispatched asynchronously here, so calling
         this for epoch e+1 while epoch e's validation runs hides the
         host->device index upload entirely (epoch-granularity prefetch)."""
-        sampler = self._sampler(self.train_ds, True, epoch)
-        idx, _ = sampler.indices_with_valid()
-        nb = sampler.num_samples // self.local_batch
-        batches = np.asarray(idx[:nb * self.local_batch],
-                             np.int32).reshape(nb, self.local_batch)[skip:]
+        batches, _ = self._epoch_indices(self.train_ds, True, epoch)
+        batches = batches[skip:]
         return [(len(w), put(np.ascontiguousarray(w)))
                 for w in (batches[i:i + self.k]
                           for i in range(0, len(batches), self.k))]
@@ -418,18 +432,10 @@ class Trainer:
         unlike the reference's per-batch barrier+allreduce. With an
         HBM-resident val set the whole eval is ONE dispatch."""
         if self._val_data_dev is not None:
-            sampler = self._sampler(self.val_ds, False, epoch)
-            idx, valid = sampler.indices_with_valid()
-            nb = sampler.num_samples // self.local_batch
-            n = nb * self.local_batch
-            shape = (nb, self.local_batch)
+            idx, valid = self._epoch_indices(self.val_ds, False, epoch)
             win_sh = NamedSharding(self.mesh, P(None, "data"))
-            idx_d = assemble_global(
-                win_sh, np.ascontiguousarray(
-                    np.asarray(idx[:n], np.int32).reshape(shape)))
-            valid_d = assemble_global(
-                win_sh, np.ascontiguousarray(
-                    np.asarray(valid[:n], np.float32).reshape(shape)))
+            idx_d = assemble_global(win_sh, np.ascontiguousarray(idx))
+            valid_d = assemble_global(win_sh, np.ascontiguousarray(valid))
             m = jax.device_get(self.window_eval_step(
                 self.state.params, self.state.batch_stats,
                 *self._val_data_dev, idx_d, valid_d))
