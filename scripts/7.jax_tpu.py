@@ -9,11 +9,13 @@ resume. Single chip to multi-host pod with the same script: processes join
 via tpu_dist.parallel.launch (TPU metadata / TPU_DIST_* / Slurm env).
 """
 
+import argparse
+import dataclasses
 import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from tpu_dist.configs import TrainConfig, parse_config
+from tpu_dist.configs import TrainConfig, add_args
 from tpu_dist.engine import Trainer
 from tpu_dist.parallel import launch
 
@@ -22,12 +24,19 @@ DEFAULTS = TrainConfig(arch="resnet50", epochs=10, batch_size=1024,
                        steps_per_dispatch=16, log_csv="jax_tpu.csv")
 
 if __name__ == "__main__":
-    cfg = parse_config(defaults=DEFAULTS, description=__doc__)
-    if cfg.variant != "jit" and cfg.steps_per_dispatch == DEFAULTS.steps_per_dispatch:
-        # windowed dispatch is a jit-variant feature; an explicit
-        # --steps-per-dispatch with shard_map still errors clearly in Trainer
-        import dataclasses
-        cfg = dataclasses.replace(cfg, steps_per_dispatch=1)
+    parser = argparse.ArgumentParser(description=__doc__)
+    add_args(parser, DEFAULTS)
+    # sentinel default: 'not passed' is distinguishable from an explicit 16,
+    # so the jit-only 16-step default downgrades for shard_map but any
+    # EXPLICIT value (prefix abbreviations included — argparse resolves
+    # them) reaches Trainer's validation and errors clearly
+    parser.set_defaults(steps_per_dispatch=None)
+    ns = parser.parse_args()
+    if ns.steps_per_dispatch is None:
+        ns.steps_per_dispatch = (DEFAULTS.steps_per_dispatch
+                                 if ns.variant == "jit" else 1)
+    cfg = TrainConfig(**{f.name: getattr(ns, f.name)
+                         for f in dataclasses.fields(TrainConfig)})
     info = launch.initialize()
     print(f"[proc {info.process_id}/{info.num_processes}] via {info.method}")
     best = Trainer(cfg).fit()

@@ -55,6 +55,32 @@ def test_param_count_matches_torchvision(arch):
     assert ours == torch_params, f"{arch}: {ours} vs torchvision {torch_params}"
 
 
+@pytest.mark.parametrize("arch", ["vgg16", "vgg11", "densenet121"])
+def test_cnn_zoo_forward_shape(arch):
+    """Non-ResNet CNN plans (registry-breadth parity with the reference's
+    any-torchvision-arch factory, 1.dataparallel.py:23-24): same input sizes
+    the reference pushes through its factory."""
+    m = create_model(arch, num_classes=10)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = m.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    out = m.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert "batch_stats" in variables  # BN plans carry running stats
+
+
+def test_densenet121_feature_param_count_matches_torchvision():
+    """DenseNet121's conv/BN plan (no-bias convs, GAP head) maps 1:1 onto
+    torchvision's — exact trainable-parameter equality."""
+    torchvision = pytest.importorskip("torchvision")
+    tm = torchvision.models.densenet121(num_classes=10)
+    torch_params = sum(p.numel() for p in tm.parameters())
+    m = create_model("densenet121", num_classes=10)
+    variables = m.init({"params": jax.random.PRNGKey(0)},
+                       jnp.zeros((1, 32, 32, 3)), train=False)
+    ours = _param_count(variables["params"])
+    assert ours == torch_params, f"{ours} vs torchvision {torch_params}"
+
+
 def test_bf16_model_keeps_fp32_bn_stats():
     m = create_model("resnet18", dtype=jnp.bfloat16)
     variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),

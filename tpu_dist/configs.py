@@ -72,6 +72,11 @@ class TrainConfig:
     steps_per_dispatch: int = 1        # K optimizer steps per XLA dispatch
                                        # (lax.scan window; amortizes controller
                                        # latency — requires variant 'jit')
+    grad_accum_steps: int = 1          # microbatches per optimizer step: the
+                                       # global batch is split into N
+                                       # sequential microbatches whose grads
+                                       # average into ONE update (for global
+                                       # batches beyond device memory)
     data_placement: str = "auto"       # host | device | auto: 'device' keeps
                                        # the whole uint8 dataset in HBM and
                                        # sends only index windows per step
@@ -91,9 +96,66 @@ class TrainConfig:
         return self.lr * world_size if self.lr_scale_by_world else self.lr
 
 
-def add_args(parser: argparse.ArgumentParser, defaults: TrainConfig) -> None:
-    """Register every TrainConfig field as a --flag (reference C1 parity)."""
-    for f in dataclasses.fields(TrainConfig):
+@dataclass
+class LMConfig:
+    """Knobs of the LM half of the framework (no reference analog — the
+    reference is image-only; SURVEY.md §2c). Mirrors TrainConfig's shape so
+    scripts build their parsers the same way (add_args works on both)."""
+
+    # -- corpus (tpu_dist.data.tokens)
+    data: str = ""                 # token file (.bin uint16 / .npy); empty
+                                   # or missing -> synthetic affine corpus
+    val_data: str = ""             # separate val token file (else tail split)
+    val_frac: float = 0.05         # held-out tail fraction of the stream
+    synth_tokens: int = 2_000_000  # synthetic corpus length
+    vocab_size: int = 512
+    seq_len: int = 512
+
+    # -- model
+    num_layers: int = 4
+    d_model: int = 256
+    num_heads: int = 8
+    num_experts: int = 0           # MoE feed-forward with N experts (0=dense)
+    router_top_k: int = 1          # 1 = Switch top-1, 2 = GShard top-2
+    attn: str = "full"             # full | blockwise | flash (Pallas FA2)
+    attn_block: int = 512          # KV block for blockwise/flash
+    remat: bool = False            # jax.checkpoint each block (HBM lever)
+    precision: str = "fp32"        # fp32 | bf16
+
+    # -- schedule
+    epochs: int = 1
+    max_steps: int = 0             # stop after N optimizer steps (0 = off;
+                                   # smoke tests / fixed-step runs)
+    batch_size: int = 16           # GLOBAL batch in sequences
+    lr: float = 3e-2
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+
+    # -- distribution (mesh axes pick the parallelism: data / model / seq /
+    #    expert / stage — see scripts/8)
+    mesh_shape: Optional[Sequence[int]] = None
+    mesh_axes: Sequence[str] = ("data",)
+    fsdp: bool = False             # ZeRO-3 param+opt sharding over 'data'
+    pp_microbatches: int = 4       # GPipe microbatches (with a 'stage' axis)
+
+    # -- dispatch/data path (same TPU levers as TrainConfig)
+    steps_per_dispatch: int = 1
+    data_placement: str = "auto"   # auto | host | device (HBM-resident rows)
+
+    # -- loop control
+    print_freq: int = 10
+    evaluate: bool = False
+    seed: Optional[int] = 0
+    resume: str = ""
+    checkpoint_dir: str = ""
+    log_csv: str = ""
+
+
+def add_args(parser: argparse.ArgumentParser, defaults) -> None:
+    """Register every config field as a --flag (reference C1 parity).
+    Works for TrainConfig and LMConfig alike (fields come from the
+    defaults instance's own dataclass)."""
+    for f in dataclasses.fields(type(defaults)):
         name = "--" + f.name.replace("_", "-")
         default = getattr(defaults, f.name)
         if f.type == "bool" or isinstance(default, bool):
@@ -115,10 +177,12 @@ def add_args(parser: argparse.ArgumentParser, defaults: TrainConfig) -> None:
 
 def parse_config(argv: Optional[Sequence[str]] = None,
                  defaults: Optional[TrainConfig] = None,
-                 description: str = "tpu_dist training") -> TrainConfig:
-    defaults = defaults or TrainConfig()
+                 description: str = "tpu_dist training"):
+    """Parse argv into a config of the same dataclass as ``defaults``."""
+    defaults = defaults if defaults is not None else TrainConfig()
+    cls = type(defaults)
     parser = argparse.ArgumentParser(description=description)
     add_args(parser, defaults)
     ns = parser.parse_args(argv)
-    return TrainConfig(**{f.name: getattr(ns, f.name)
-                          for f in dataclasses.fields(TrainConfig)})
+    return cls(**{f.name: getattr(ns, f.name)
+                  for f in dataclasses.fields(cls)})

@@ -120,7 +120,27 @@ class Trainer:
             self.val_ds.mean, self.val_ds.std, augment=False,
             dtype=self.policy.compute_dtype)
 
-        if cfg.variant == "shard_map":
+        # gradient accumulation: split each global batch into N sequential
+        # microbatches whose grads average into ONE optimizer step (steps.py
+        # make_grad_accum_train_step) — for global batches beyond HBM
+        self.accum = cfg.grad_accum_steps
+        if self.accum < 1:
+            raise ValueError("grad_accum_steps must be >= 1")
+        if self.accum > 1 and cfg.variant != "jit":
+            raise ValueError("grad_accum_steps > 1 requires variant='jit'")
+        if self.accum > 1 and cfg.steps_per_dispatch > 1:
+            raise ValueError("grad_accum_steps and steps_per_dispatch > 1 "
+                             "are mutually exclusive")
+        if self.accum > 1 and cfg.batch_size % (self.accum * ndev):
+            raise ValueError(
+                f"global batch {cfg.batch_size} not divisible by "
+                f"grad_accum_steps x device count ({self.accum} x {ndev})")
+
+        if self.accum > 1:
+            from tpu_dist.engine.steps import make_grad_accum_train_step
+            self.train_step = make_grad_accum_train_step(
+                self.model, self.tx, self.transform, self.mesh)
+        elif cfg.variant == "shard_map":
             self.train_step = make_shard_map_train_step(
                 self.model, self.tx, self.transform, self.mesh,
                 grad_compression=cfg.grad_compression,
@@ -144,6 +164,11 @@ class Trainer:
         if cfg.data_placement == "device" and not in_memory:
             raise ValueError("data_placement='device' needs an in-memory "
                              "(ArrayDataset) training set")
+        if cfg.data_placement == "device" and self.accum > 1:
+            # the indexed window step has no microbatch loop; accumulation
+            # rides the host-fed per-batch path
+            raise ValueError("grad_accum_steps > 1 requires "
+                             "data_placement='host' or 'auto'")
         if cfg.data_placement == "device" and cfg.variant != "jit":
             # the indexed window step is compiler-partitioned; routing a
             # shard_map config through it would silently drop grad
@@ -215,6 +240,18 @@ class Trainer:
                           "image_shape": list(self.train_ds.image_shape)}
 
         if cfg.resume:
+            # hard geometry first, from the meta header alone: a wrong-arch
+            # blob fails inside flax from_bytes with an opaque structure
+            # mismatch, so the clear error must fire BEFORE deserialization
+            pre = ckpt.read_checkpoint_meta(cfg.resume)
+            hard_pre = {k: (pre[k], v) for k, v in self._run_meta.items()
+                        if k in ("arch", "num_classes", "image_shape")
+                        and k in pre and pre[k] != v}
+            if hard_pre:
+                raise ValueError(
+                    "--resume checkpoint is from a different model geometry ("
+                    + ", ".join(f"{k}: checkpoint {a} vs run {b}"
+                                for k, (a, b) in hard_pre.items()) + ")")
             self.state, meta = ckpt.load_checkpoint(cfg.resume, state)
             self.state = jax.device_put(self.state, replicated(self.mesh))
             self.start_epoch = meta.get("epoch", 0)
@@ -302,7 +339,20 @@ class Trainer:
         self._skip_batches = 0
         pending = []
         end = time.time()
-        it = prefetch_to_device(iter(loader), self.batch_sharding)
+        if self.accum > 1:
+            # host-side split into (N, B/N, ...) microbatches; sharded
+            # (None, 'data') so every microbatch spans all devices
+            n = self.accum
+
+            def split(b):
+                imgs, lbls = b
+                return (imgs.reshape(n, -1, *imgs.shape[1:]),
+                        lbls.reshape(n, -1))
+
+            micro_sh = NamedSharding(self.mesh, P(None, "data"))
+            it = prefetch_to_device(map(split, iter(loader)), micro_sh)
+        else:
+            it = prefetch_to_device(iter(loader), self.batch_sharding)
         for i, (images, labels) in enumerate(it):
             if i < skip:  # step-exact resume of a mid-epoch checkpoint
                 end = time.time()
@@ -404,7 +454,10 @@ class Trainer:
         last_print = skip - 1
         end = time.time()
         for n, dev_payload in windows:
-            meters.update("Data", time.time() - end, n)
+            # per-BATCH seconds (window seconds / n, weighted n) so the
+            # printed avg keeps the per-batch path's meaning:
+            # avg(Time) = wall / batches in both paths
+            meters.update("Data", (time.time() - end) / n, n)
             self.state, metrics = dispatch(self.state, dev_payload)
             done += n
             pending.append(metrics)
@@ -418,7 +471,7 @@ class Trainer:
             if boundary:
                 self._drain(pending, meters)
                 last_print = done - 1
-            meters.update("Time", time.time() - end, n)
+            meters.update("Time", (time.time() - end) / n, n)
             if boundary and self.is_main:
                 meters.display(done - 1)
             end = time.time()

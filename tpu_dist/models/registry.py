@@ -19,7 +19,7 @@ from typing import Callable, Dict, Tuple
 
 import jax.numpy as jnp
 
-from tpu_dist.models import lenet, moe, resnet, transformer, vit
+from tpu_dist.models import cnn_zoo, lenet, moe, resnet, transformer, vit
 
 # name -> (constructor, kind)
 _REGISTRY: Dict[str, Tuple[Callable, str]] = {
@@ -28,6 +28,9 @@ _REGISTRY: Dict[str, Tuple[Callable, str]] = {
     "resnet50": (resnet.ResNet50, "image"),
     "resnet101": (resnet.ResNet101, "image"),
     "resnet152": (resnet.ResNet152, "image"),
+    "vgg11": (cnn_zoo.VGG11, "image"),
+    "vgg16": (cnn_zoo.VGG16, "image"),
+    "densenet121": (cnn_zoo.DenseNet121, "image"),
     "lenet": (lenet.LeNet, "image"),
     "mnist_net": (lenet.LeNet, "image"),  # reference 5.2 'Net' alias
     "vit_tiny": (vit.ViTTiny, "image"),
