@@ -79,6 +79,25 @@ def test_offsets_respected():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_fully_masked_rows_zero():
+    """kv_offset > q_offset makes EVERY key future for the early queries:
+    those rows must output zeros (not the unmasked mean of V, which the
+    online softmax produces when masked probabilities aren't zeroed)."""
+    q, k, v = _qkv(6, l=64)
+    # kv block starts 64 positions AFTER the queries -> all rows fully masked
+    blk = blockwise_attention_fn(32)(q, k, v, q_offset=0, kv_offset=64)
+    fl = flash_attention_fn(block_q=32)(q, k, v, q_offset=0, kv_offset=64)
+    np.testing.assert_allclose(np.asarray(blk), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(fl), 0.0, atol=1e-6)
+    # partial masking: kv_offset = q_offset + 32 -> first 32 rows masked
+    blk2 = blockwise_attention_fn(32)(q, k, v, q_offset=0, kv_offset=32)
+    ref = full_attention(q, k, v, q_offset=0, kv_offset=32)
+    ref = jnp.nan_to_num(ref)  # full attention NaNs on all-masked rows
+    np.testing.assert_allclose(np.asarray(blk2[:, :32]), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(blk2[:, 32:]),
+                               np.asarray(ref[:, 32:]), rtol=2e-5, atol=2e-5)
+
+
 @pytest.mark.parametrize("fn_name", ["blockwise", "flash"])
 def test_lm_forward_same_logits(fn_name):
     """The SAME TransformerLM weights produce the same logits under the
