@@ -1,25 +1,28 @@
 #!/usr/bin/env python
-"""Variant 8 — long-context transformer LM over a dp x sp / dp x tp mesh.
+"""Variant 8 — long-context transformer LM over a dp x sp / tp / ep / pp mesh.
 
 Beyond the reference (which is DP-only over image CNNs, SURVEY.md §2c):
-trains a causal LM with the parallelism picked by flags:
+trains a causal LM on a REAL token corpus through the shared LM engine
+(tpu_dist.engine.lm_loop.LMTrainer) — epochs, distributed sampler rows,
+K-steps-per-dispatch windows from HBM-resident rows, exact held-out
+perplexity in every mode, mid-epoch resume — with the parallelism picked by
+flags:
 
   --mesh data=8                 pure data parallel (jit)
   --mesh data=2,seq=4           sequence parallel: ring attention over 'seq'
   --mesh data=4,model=2         tensor parallel: Megatron shardings via GSPMD
-  --mesh data=2,stage=4         pipeline parallel: GPipe microbatches over
-                                'stage' (--pp-microbatches)
+  --mesh data=2,expert=4        MoE expert parallelism (with --num-experts)
+  --mesh data=2,stage=4         pipeline parallel: GPipe microbatches
 
-Data is a synthetic deterministic token stream (affine next-token rule +
-noise) so the loss curve is meaningful without downloads. Prints per-step
-loss and tokens/sec; same multi-host launch story as every other variant
-(tpu_dist.parallel.launch).
+Data: --data points at a token file (.bin uint16 / .npy, nanoGPT-style);
+absent, a deterministic synthetic affine corpus is generated so the loss
+curve is meaningful without downloads. --steps N caps optimizer steps
+(smoke runs); otherwise --epochs governs. Same multi-host launch story as
+every other variant (tpu_dist.parallel.launch).
 """
 
 import argparse
 import sys
-import time
-from functools import partial
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
@@ -36,50 +39,15 @@ def parse_mesh(s):
 
 
 def main():
+    from tpu_dist.configs import LMConfig, add_args
+
     ap = argparse.ArgumentParser(description=__doc__)
+    add_args(ap, LMConfig())
     ap.add_argument("--mesh", type=parse_mesh, default=None,
-                    help="e.g. data=2,seq=4 | data=4,model=2 | data=8")
-    ap.add_argument("--steps", type=int, default=50)
-    ap.add_argument("--batch-size", type=int, default=16, help="global batch (sequences)")
-    ap.add_argument("--seq-len", type=int, default=512)
-    ap.add_argument("--vocab-size", type=int, default=512)
-    ap.add_argument("--num-layers", type=int, default=4)
-    ap.add_argument("--d-model", type=int, default=256)
-    ap.add_argument("--num-heads", type=int, default=8)
-    ap.add_argument("--lr", type=float, default=3e-2)
-    ap.add_argument("--precision", default="fp32", choices=["fp32", "bf16"])
-    ap.add_argument("--print-freq", type=int, default=10)
-    ap.add_argument("--fsdp", action="store_true",
-                    help="shard params+optimizer state over the data axis "
-                         "(ZeRO-3 placement; same step function)")
-    ap.add_argument("--num-experts", type=int, default=0,
-                    help="MoE feed-forward with N experts (0 = dense); with "
-                         "--mesh data=2,expert=4 experts shard over the "
-                         "'expert' axis (GShard-style expert parallelism)")
-    ap.add_argument("--pp-microbatches", type=int, default=4,
-                    help="GPipe microbatches per step (with a 'stage' axis)")
-    ap.add_argument("--router-top-k", type=int, default=1, choices=[1, 2],
-                    help="MoE routing: 1 = Switch top-1, 2 = GShard top-2")
-    ap.add_argument("--attn", default="full",
-                    choices=["full", "blockwise", "flash"],
-                    help="attention flavor: full O(L^2) memory; blockwise "
-                         "online-softmax O(L*block); flash = Pallas forward "
-                         "kernel + recompute backward (non-sp meshes)")
-    ap.add_argument("--attn-block", type=int, default=512,
-                    help="KV block size for blockwise/flash recompute")
-    ap.add_argument("--remat", action="store_true",
-                    help="jax.checkpoint each transformer block (trade "
-                         "FLOPs for HBM; the long-context memory lever)")
-    ap.add_argument("--checkpoint-dir", default="",
-                    help="save checkpoints here (also on Ctrl-C); empty = off")
-    ap.add_argument("--save-freq", type=int, default=0,
-                    help="checkpoint every N steps (0 = only at end/interrupt)")
-    ap.add_argument("--resume", default="",
-                    help="checkpoint to resume from (continues at its step)")
-    ap.add_argument("--eval-size", type=int, default=0,
-                    help="hold out N sequences (same distribution, fresh "
-                         "seed) and report val loss/perplexity at every "
-                         "print and at the end (dense-mesh modes)")
+                    help="e.g. data=2,seq=4 | data=4,model=2 | data=8 "
+                         "(overrides --mesh-shape/--mesh-axes)")
+    ap.add_argument("--steps", type=int, default=0,
+                    help="alias for --max-steps (cookbook compat)")
     ap.add_argument("--generate", type=int, default=0,
                     help="after training, greedy-decode N tokens from the "
                          "trained model and report how often they follow "
@@ -89,254 +57,31 @@ def main():
     from tpu_dist.parallel import launch
     info = launch.initialize()
 
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from tpu_dist.engine import checkpoint as ckpt
-    from tpu_dist.engine.lm_steps import (make_lm_batches,
-                                          make_lm_sp_train_step,
-                                          make_lm_train_step)
-    from tpu_dist.engine.state import TrainState
-    from tpu_dist.models.transformer import tiny_lm
-    from tpu_dist.ops import make_optimizer, make_policy
-    from tpu_dist.parallel.mesh import make_mesh, replicated
-    from tpu_dist.parallel.tp import shard_lm_params
+    from tpu_dist.engine.lm_loop import LMTrainer
 
-    mesh_shape, mesh_axes = args.mesh if args.mesh else ((jax.device_count(),),
-                                                        ("data",))
-    mesh = make_mesh(mesh_shape, mesh_axes)
-    policy = make_policy(args.precision)
-    if args.attn != "full":
-        from tpu_dist.ops.flash_attention import (blockwise_attention_fn,
-                                                  flash_attention_fn)
-        attn_fn = (blockwise_attention_fn(args.attn_block)
-                   if args.attn == "blockwise"
-                   else flash_attention_fn(recompute_block=args.attn_block))
-    else:
-        from tpu_dist.models.transformer import full_attention
-        attn_fn = full_attention
-    lm_kw = dict(vocab_size=args.vocab_size, num_layers=args.num_layers,
-                 d_model=args.d_model, num_heads=args.num_heads,
-                 max_len=args.seq_len, dtype=policy.compute_dtype,
-                 attn_fn=attn_fn, remat=args.remat)
-    if args.num_experts:
-        if args.remat:
-            raise SystemExit("--remat supports the dense TransformerLM only")
-        from tpu_dist.models.moe import MoETransformerLM
-        moe_kw = {k: v for k, v in lm_kw.items() if k != "remat"}
-        model = MoETransformerLM(num_experts=args.num_experts,
-                                 router_top_k=args.router_top_k, **moe_kw)
-    else:
-        model = tiny_lm(**lm_kw)
-    params = model.init({"params": jax.random.PRNGKey(0)},
-                        jnp.zeros((1, args.seq_len), jnp.int32),
-                        train=False)["params"]
-    tx = make_optimizer(args.lr, 0.9, 0.0, steps_per_epoch=10 ** 6)
-    state = TrainState.create(params, {}, tx)
+    cfg = LMConfig(**{f.name: getattr(args, f.name)
+                      for f in dataclasses.fields(LMConfig)})
+    if args.mesh:
+        cfg = dataclasses.replace(cfg, mesh_shape=args.mesh[0],
+                                  mesh_axes=args.mesh[1])
+    if args.steps:
+        cfg = dataclasses.replace(cfg, max_steps=args.steps)
 
-    use_sp = "seq" in mesh.axis_names and mesh.shape["seq"] > 1
-    use_tp = "model" in mesh.axis_names and mesh.shape["model"] > 1
-    use_ep = "expert" in mesh.axis_names and mesh.shape["expert"] > 1
-    use_pp = "stage" in mesh.axis_names and mesh.shape["stage"] > 1
-    if use_pp and (use_sp or use_tp or use_ep or args.num_experts or args.fsdp):
-        raise SystemExit("a 'stage' mesh axis composes only with 'data' "
-                         "(GPipe over dense TransformerLM blocks)")
-    if args.fsdp and (use_sp or use_tp or use_ep):
-        print("warning: --fsdp applies to the pure data-parallel layout; "
-              "ignored with a seq/model/expert mesh axis", flush=True)
-    if use_ep and not args.num_experts:
-        raise SystemExit("an 'expert' mesh axis requires --num-experts > 0")
-    if use_sp and args.num_experts:
-        raise SystemExit("MoE + sequence parallelism not supported yet "
-                         "(ring attention path builds the dense model)")
-    if use_sp and args.attn != "full":
-        print("warning: a 'seq' mesh axis uses ring attention; "
-              f"--attn {args.attn} ignored", flush=True)
-    if use_tp and args.num_experts:
-        raise SystemExit("MoE + tensor parallelism not supported: the TP "
-                         "rules don't shard 3-D expert weights — use "
-                         "--mesh data=N,expert=M instead")
-    if use_pp:
-        # stacked layout BEFORE TrainState.create so the optimizer state
-        # mirrors it (also makes it the checkpoint/resume template)
-        from tpu_dist.parallel.pp import (make_lm_pp_train_step,
-                                          shard_state_pp,
-                                          stack_pipeline_params)
-        params = stack_pipeline_params(params, mesh.shape["stage"])
-        state = TrainState.create(params, {}, tx)
-
-    def place(st):
-        """Apply the mode's sharding; also re-places a resumed host state."""
-        if use_pp:
-            return shard_state_pp(mesh, st)
-        if use_sp:
-            return jax.device_put(st, replicated(mesh))
-        if use_ep:
-            from tpu_dist.parallel.ep import shard_state_ep
-            return shard_state_ep(mesh, st)
-        if use_tp:
-            return TrainState(
-                step=jax.device_put(st.step, NamedSharding(mesh, P())),
-                params=shard_lm_params(mesh, st.params), batch_stats={},
-                opt_state=jax.device_put(st.opt_state,
-                                         NamedSharding(mesh, P())),
-                loss_scale=None)
-        if args.fsdp:
-            from tpu_dist.parallel.fsdp import shard_state_fsdp
-            return shard_state_fsdp(mesh, st)
-        return jax.device_put(st, replicated(mesh))
-
-    if use_pp:
-        step = make_lm_pp_train_step(model, tx, mesh, args.pp_microbatches)
-        data_spec = P("data", None)
-    elif use_sp:
-        step = make_lm_sp_train_step(partial(tiny_lm, **lm_kw), tx, mesh)
-        data_spec = P("data", "seq")
-    else:
-        step = make_lm_train_step(model, tx, mesh)
-        data_spec = P("data")
-
-    # model geometry stamped into every checkpoint; a mismatched resume must
-    # fail with a clear message, not a deep XLA shape error (or worse: a
-    # pp checkpoint resumed with a different stage count reshards the
-    # stage-stacked blocks wrongly and silently drops layers)
-    geometry = {"vocab_size": args.vocab_size, "num_layers": args.num_layers,
-                "d_model": args.d_model, "num_heads": args.num_heads,
-                "seq_len": args.seq_len, "num_experts": args.num_experts,
-                "pp_stages": mesh.shape["stage"] if use_pp else 0}
-
-    start_step = 0
-    if args.resume:
-        # validate geometry from the meta header BEFORE deserializing: a
-        # wrong-shaped blob fails opaquely (or, for pp stage counts, loads
-        # and silently missplits the stage-stacked blocks)
-        meta = ckpt.read_checkpoint_meta(args.resume)
-        bad = {k: (meta[k], v) for k, v in geometry.items()
-               if k in meta and meta[k] != v}
-        if bad:
-            raise SystemExit(
-                "--resume checkpoint has different model geometry: " +
-                ", ".join(f"{k}: checkpoint {a} vs flags {b}"
-                          for k, (a, b) in bad.items()))
-        # load into the freshly-initialized (host) template, THEN shard —
-        # works for every mode because placement is orthogonal to the blob
-        state, meta = ckpt.load_checkpoint(args.resume, state)
-        start_step = int(np.asarray(state.step))
-        if jax.process_index() == 0:
-            print(f"=> resumed from {args.resume} (step {start_step})",
-                  flush=True)
-    state = place(state)
-
-    # synthetic affine-rule token stream (learnable, deterministic)
-    def affine_stream(n_rows, seed):
-        rng = np.random.default_rng(seed)
-        start = rng.integers(0, args.vocab_size, (n_rows, 1))
-        rows = [start]
-        for _ in range(args.seq_len):
-            nxt = (rows[-1] * 5 + 7) % args.vocab_size
-            flip = rng.random(nxt.shape) < 0.05
-            rows.append(np.where(flip,
-                                 rng.integers(0, args.vocab_size, nxt.shape),
-                                 nxt))
-        return np.concatenate(rows, axis=1).astype(np.int32)
-
-    inputs, targets = make_lm_batches(affine_stream(args.batch_size, seed=0))
-    sh = NamedSharding(mesh, data_spec)
-    inputs = jax.device_put(inputs, sh)
-    targets = jax.device_put(targets, sh)
-
-    eval_step = None
-    if args.eval_size:
-        if use_sp or use_pp:
-            raise SystemExit("--eval-size supports the dense-mesh modes "
-                             "(dp/fsdp/tp/ep); sp/pp evaluate via their "
-                             "train-loss curves")
-        if args.eval_size % mesh.shape["data"]:
-            raise SystemExit(f"--eval-size {args.eval_size} must divide by "
-                             f"the data axis ({mesh.shape['data']})")
-        from tpu_dist.engine.lm_steps import make_lm_eval_step
-        eval_step = make_lm_eval_step(model, mesh)
-        vi, vt = make_lm_batches(affine_stream(args.eval_size, seed=1))
-        vi = jax.device_put(vi, sh)
-        vt = jax.device_put(vt, sh)
-
-        eval_secs = [0.0]  # excluded from the throughput window
-
-        def evaluate(st):
-            t = time.perf_counter()
-            m = jax.device_get(eval_step(st.params, vi, vt))
-            eval_secs[0] += time.perf_counter() - t
-            loss = float(m["loss_sum"]) / float(m["count"])
-            return loss, float(np.exp(min(loss, 30.0))), \
-                float(m["correct1"]) / float(m["count"])
-
-    mode = ("pp-gpipe" if use_pp else
-            "sp-ring" if use_sp else
-            "ep-moe" if use_ep else
-            "tp" if use_tp else
-            "fsdp" if args.fsdp else
-            ("dp-moe" if args.num_experts else "dp"))
+    trainer = LMTrainer(cfg)
     if jax.process_index() == 0:
-        print(f"[proc {info.process_id}/{info.num_processes}] mesh={dict(mesh.shape)} "
-              f"mode={mode} tokens/step={args.batch_size * args.seq_len}")
-    last_saved = [-1]
-
-    def save(st, step_no):
-        if not args.checkpoint_dir or step_no == last_saved[0]:
-            return  # off, or this exact step already on disk
-        # gathers cross-host shards inside (collective) — every process calls
-        ckpt.save_checkpoint(args.checkpoint_dir, st, 0, 0.0, "lm",
-                             is_best=False,
-                             extra_meta={"mode": mode, **geometry})
-        last_saved[0] = step_no
-
-    key = jax.random.PRNGKey(1)
-    i = start_step
-    t0 = time.perf_counter()
-    timed_from = start_step  # first step compiles; throughput excludes it
-    try:
-        for i in range(start_step, args.steps):
-            state, metrics = step(state, inputs, targets, key)
-            if i == start_step and args.steps - start_step > 1:
-                jax.block_until_ready(metrics)
-                t0 = time.perf_counter()
-                timed_from = start_step + 1
-            if i % args.print_freq == 0 or i == args.steps - 1:
-                m = jax.device_get(metrics)
-                loss = float(m["loss_sum"]) / float(m["count"])
-                acc = float(m["correct1"]) / float(m["count"])
-                if eval_step is not None:
-                    vl, ppl, va = evaluate(state)
-                    if jax.process_index() == 0:
-                        print(f"step {i:4d} loss {loss:.4f} acc {acc:.3f} | "
-                              f"val_loss {vl:.4f} ppl {ppl:.2f} "
-                              f"val_acc {va:.3f}")
-                elif jax.process_index() == 0:
-                    print(f"step {i:4d} loss {loss:.4f} acc {acc:.3f}")
-            if args.save_freq and (i + 1) % args.save_freq == 0:
-                save(state, i + 1)
-    except KeyboardInterrupt:
-        # best-effort on multi-host sharded state: peers interrupted at a
-        # different step would desync the collective gather — single-host
-        # (the normal Ctrl-C case) is always safe
-        save(state, i + 1)
-        if jax.process_index() == 0:
-            print(("interrupted — checkpoint saved at step "
-                   f"{int(np.asarray(jax.device_get(state.step)))}; "
-                   "resume with --resume") if args.checkpoint_dir else
-                  "interrupted — no --checkpoint-dir, nothing saved",
-                  flush=True)
-        raise
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
-    if eval_step is not None:
-        dt -= eval_secs[0]  # eval (incl. its compile) is not training time
-    save(state, args.steps)
-    toks = (args.steps - timed_from) * args.batch_size * args.seq_len
-    if jax.process_index() == 0:
-        print(f"throughput {toks / dt:,.0f} tokens/sec ({mode}, "
-              f"{args.steps - timed_from} timed steps)")
+        print(f"[proc {info.process_id}/{info.num_processes}] "
+              f"mesh={dict(trainer.mesh.shape)} mode={trainer.mode} "
+              f"corpus={trainer.train_ds.name} rows={len(trainer.train_ds)} "
+              f"tokens/step={cfg.batch_size * cfg.seq_len}")
+    best_ppl = trainer.fit()
+    if jax.process_index() == 0 and not cfg.evaluate:
+        print(f"throughput {trainer.last_tok_s:,.0f} tokens/sec "
+              f"({trainer.mode}) best_ppl {best_ppl:.2f}")
 
     if args.generate:
         # decode on host-replicated params; the gather is a COLLECTIVE for
@@ -345,23 +90,25 @@ def main():
         # to the dense tree first.
         from tpu_dist.engine.checkpoint import gather_to_host
         from tpu_dist.engine.generate import generate
-        host_params = gather_to_host(state.params)
+        host_params = gather_to_host(trainer.state.params)
     if args.generate and jax.process_index() == 0:
-        if use_pp:
+        from tpu_dist.models.transformer import tiny_lm
+        if trainer.use_pp:
             from tpu_dist.parallel.pp import unstack_pipeline_params
             host_params = unstack_pipeline_params(host_params)
-        n = min(args.generate, args.seq_len - 2)
+        n = min(args.generate, cfg.seq_len - 2)
         seed = 3
-        prompt = jnp.asarray([[seed, (seed * 5 + 7) % args.vocab_size]],
+        prompt = jnp.asarray([[seed, (seed * 5 + 7) % trainer.vocab_size]],
                              jnp.int32)
         # sp's model closes over mesh axis names (ring attention); decode
         # with the dense equivalent — same weights, same math. Dense models
         # decode through the KV cache; MoE uses full recompute.
-        gen_model = tiny_lm(**lm_kw) if use_sp else model
+        gen_model = (tiny_lm(**trainer._model_ctor_kw) if trainer.use_sp
+                     else trainer.model)
         out = np.asarray(generate(gen_model, host_params, prompt, steps=n,
-                                  use_cache=not args.num_experts))
+                                  use_cache=not cfg.num_experts))
         follows = sum(int(out[0, i + 1])
-                      == (int(out[0, i]) * 5 + 7) % args.vocab_size
+                      == (int(out[0, i]) * 5 + 7) % trainer.vocab_size
                       for i in range(1, n + 1))
         print(f"generated {n} tokens, {follows}/{n} follow the affine rule: "
               f"{out[0].tolist()}")

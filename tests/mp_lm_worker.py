@@ -1,0 +1,60 @@
+"""One worker process of a loopback multi-process LM run (test_multiprocess).
+
+The LM twin of mp_worker.py: each process owns a slice of virtual CPU
+devices, rendezvouses through tpu_dist.parallel.launch, and drives the SAME
+LMTrainer as single-process runs over the SAME synthetic corpus — the
+N-process bit-match check the image engine has had since round 2, applied to
+the token path (sampler rows, windows, distributed eval included).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    out = os.environ["TPU_DIST_TEST_OUT"]
+    local_devices = int(os.environ.get("TPU_DIST_LOCAL_DEVICES", "2"))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", local_devices)
+
+    from tpu_dist.parallel import launch
+
+    info = launch.initialize()
+    expected = int(os.environ.get("TPU_DIST_EXPECT_PROCS", "1"))
+    assert jax.process_count() == expected, (jax.process_count(), expected)
+
+    import numpy as np
+
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    cfg = LMConfig(
+        batch_size=8, seq_len=32, d_model=32, num_layers=1, num_heads=2,
+        vocab_size=64, synth_tokens=2000, seed=5, print_freq=100, epochs=1,
+        lr=1e-2, checkpoint_dir=os.path.join(out, "ckpt"),
+        steps_per_dispatch=int(os.environ.get("TPU_DIST_TEST_K", "1")),
+        data_placement=os.environ.get("TPU_DIST_TEST_PLACEMENT", "auto"))
+    trainer = LMTrainer(cfg)
+    best_ppl = trainer.fit()
+
+    if jax.process_index() == 0:
+        leaves = jax.tree_util.tree_leaves(
+            jax.device_get(trainer.state.params))
+        np.savez(os.path.join(out, "params.npz"),
+                 **{f"p{i}": np.asarray(x, np.float32)
+                    for i, x in enumerate(leaves)})
+        with open(os.path.join(out, "result.json"), "w") as f:
+            json.dump({"best_ppl": float(best_ppl),
+                       "process_count": jax.process_count(),
+                       "method": info.method,
+                       "step": int(jax.device_get(trainer.state.step))}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -170,7 +170,8 @@ def test_lm_eval_step_exact_metrics():
     inputs, targets = make_lm_batches(tokens)
     mesh = make_mesh((8,), ("data",))
     step = make_lm_eval_step(lm, mesh)
-    m = jax.device_get(step(params, jnp.asarray(inputs), jnp.asarray(targets)))
+    m = jax.device_get(step(params, jnp.asarray(inputs), jnp.asarray(targets),
+                            jnp.ones((inputs.shape[0],), jnp.float32)))
 
     logits = lm.apply({"params": params}, jnp.asarray(inputs), train=False)
     _, ref = lm_loss_and_metrics(logits, jnp.asarray(targets),

@@ -91,11 +91,12 @@ def test_script_8_lm(tmp_path):
     out = run_script(tmp_path, "8.lm_longcontext.py",
                      ["--steps", "3", "--batch-size", "4", "--seq-len", "32",
                       "--d-model", "32", "--num-layers", "1", "--num-heads",
-                      "2", "--print-freq", "1", "--eval-size", "4",
-                      "--generate", "8",
+                      "2", "--print-freq", "1", "--synth-tokens", "2000",
+                      "--vocab-size", "64", "--generate", "8",
                       "--checkpoint-dir", os.path.join(str(tmp_path), "ck")])
+    assert "corpus=synth-affine-train" in out  # real corpus, not fixed batch
     assert "throughput" in out
-    assert "ppl" in out            # --eval-size surface
+    assert "ppl" in out            # held-out perplexity surface
     assert "affine rule" in out    # --generate surface
 
 
@@ -104,10 +105,11 @@ def test_script_8_lm_pipeline_mode(tmp_path):
                      ["--mesh", "data=2,stage=2", "--steps", "3",
                       "--batch-size", "4", "--seq-len", "32", "--d-model",
                       "32", "--num-layers", "2", "--num-heads", "2",
-                      "--print-freq", "1", "--pp-microbatches", "2"],
+                      "--print-freq", "1", "--pp-microbatches", "2",
+                      "--synth-tokens", "2000", "--vocab-size", "64"],
                      env_extra={"XLA_FLAGS":
                                 "--xla_force_host_platform_device_count=4"})
-    assert "mode=pp-gpipe" in out and "throughput" in out
+    assert "mode=pp-gpipe" in out and "throughput" in out and "ppl" in out
 
 
 def test_script_evaluate_flag(tmp_path):

@@ -13,12 +13,13 @@ Three step builders over the same TransformerLM weights:
   This is the blockwise/ring long-context regime: per-device activation
   memory scales with L/n_seq.
 
-Loss: next-token cross entropy; targets are inputs shifted by one INSIDE the
-step (the final position of each sequence-shard boundary is handled by
-masking the global last token only — interior shard boundaries stay valid
-because shifting happens on the global array before sharding in the SP path's
-host loader... no: tpu_dist shifts per-shard and passes the successor token
-of the shard explicitly; see make_lm_batches).
+Loss: next-token cross entropy. Shift-by-one happens ON THE HOST over the
+global (B, L+1) token rows BEFORE any sharding (:func:`make_lm_batches`):
+inputs = rows[:, :-1], targets = rows[:, 1:]. A sequence shard's targets
+therefore already contain the first token of the following shard, so
+interior shard boundaries need no masking and the SP step's per-shard loss
+sums are exact — only the final position of the global sequence is consumed
+by the shift itself.
 """
 
 from __future__ import annotations
@@ -111,18 +112,128 @@ def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
 def make_lm_eval_step(model, mesh: Mesh, data_axis: str = DATA_AXIS,
                       ) -> Callable:
     """Forward-only metric sums on a held-out shard: (params, inputs,
-    targets) -> {loss_sum, correct1, count}. Works for any GSPMD placement
-    the params carry (dp / fsdp / tp / ep), like make_lm_train_step."""
+    targets, valid) -> {loss_sum, correct1, count}. ``valid`` (B,) 0/1
+    excludes sampler wrap-padding rows so perplexity is exact (the same
+    masking contract as the image eval, steps.py make_eval_step). Works for
+    any GSPMD placement the params carry (dp / fsdp / tp / ep)."""
     batch_sh = NamedSharding(mesh, P(data_axis))
 
-    def step(params, inputs, targets):
+    def step(params, inputs, targets, valid):
         logits = model.apply({"params": params}, inputs, train=False)
-        mask = jnp.ones(targets.shape, jnp.float32)
+        mask = jnp.broadcast_to(valid[:, None], targets.shape).astype(
+            jnp.float32)
         _, metrics = lm_loss_and_metrics(logits, targets, mask)
         return metrics
 
-    return jax.jit(step, in_shardings=(None, batch_sh, batch_sh),
+    return jax.jit(step, in_shardings=(None, batch_sh, batch_sh, batch_sh),
                    out_shardings=NamedSharding(mesh, P()))
+
+
+def make_lm_indexed_multi_train_step(model, tx, mesh: Mesh,
+                                     data_axis: str = DATA_AXIS,
+                                     aux_weight: float = 0.01,
+                                     donate: bool = True) -> Callable:
+    """K optimizer steps per dispatch from an HBM-RESIDENT token corpus.
+
+    signature: (state, rows_all (N, L+1) i32 REPLICATED, idx (K, B) i32
+    sharded (None, data), rng) -> (state, metrics summed over K steps).
+
+    The LM twin of steps.py make_indexed_multi_train_step: the whole row
+    matrix lives on device once, each scan iteration gathers its (B, L+1)
+    batch at HBM bandwidth and shifts inputs/targets ON DEVICE, and the host
+    sends only the index window — so LM training throughput tracks the
+    device step rate, not the host link. Identical math to K sequential
+    make_lm_train_step calls (same per-step rng fold). Works under any
+    GSPMD param placement (dp / fsdp / tp / ep) like the single step.
+    """
+    repl = NamedSharding(mesh, P())
+    idx_sh = NamedSharding(mesh, P(None, data_axis))
+
+    def one_step(state, inputs, targets, rng):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(p):
+            logits, aux = _apply_collect_aux(model, p, inputs, dropout_rng)
+            mask = jnp.ones(targets.shape, jnp.float32)
+            loss_sum, metrics = lm_loss_and_metrics(logits, targets, mask)
+            mean = loss_sum / jnp.maximum(metrics["count"], 1.0)
+            return mean + aux_weight * aux, ({}, metrics)
+
+        (_, (stats, metrics)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        return _apply_update(tx, state, grads, stats, metrics)
+
+    def multi(state: TrainState, rows_all, idx, rng):
+        def body(st, idx_b):
+            rows = jnp.take(rows_all, idx_b, axis=0)     # (B, L+1)
+            return one_step(st, rows[:, :-1], rows[:, 1:], rng)
+        state, metrics_k = jax.lax.scan(body, state, idx)
+        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
+
+    return jax.jit(multi, in_shardings=(None, repl, idx_sh, repl),
+                   out_shardings=(None, repl),
+                   donate_argnums=(0,) if donate else ())
+
+
+def make_lm_indexed_eval_step(model, mesh: Mesh,
+                              data_axis: str = DATA_AXIS) -> Callable:
+    """Whole-val-set perplexity in ONE dispatch from HBM-resident rows.
+
+    signature: (params, rows_all (N, L+1) REPLICATED, idx (K, B) i32 sharded
+    (None, data), valid (K, B) f32 same sharding) -> summed metrics over all
+    K batches, sampler padding masked per row."""
+    repl = NamedSharding(mesh, P())
+    idx_sh = NamedSharding(mesh, P(None, data_axis))
+
+    def step(params, rows_all, idx, valid):
+        def body(sums, blk):
+            idx_b, valid_b = blk
+            rows = jnp.take(rows_all, idx_b, axis=0)
+            inputs, targets = rows[:, :-1], rows[:, 1:]
+            logits = model.apply({"params": params}, inputs, train=False)
+            mask = jnp.broadcast_to(valid_b[:, None], targets.shape).astype(
+                jnp.float32)
+            _, m = lm_loss_and_metrics(logits, targets, mask)
+            return jax.tree.map(jnp.add, sums, m), None
+
+        zeros = {k: jnp.float32(0.0)
+                 for k in ("loss_sum", "correct1", "count")}
+        sums, _ = jax.lax.scan(body, zeros, (idx, valid))
+        return sums
+
+    return jax.jit(step, in_shardings=(None, repl, idx_sh, idx_sh),
+                   out_shardings=repl)
+
+
+def make_lm_sp_eval_step(model_ctor: Callable, mesh: Mesh,
+                         data_axis: str = DATA_AXIS,
+                         seq_axis: str = SEQ_AXIS) -> Callable:
+    """Held-out eval under sequence parallelism: (params, inputs, targets,
+    valid) with (data, seq)-sharded tokens, ring attention, metric sums
+    psum'd over BOTH axes — closing the round-2 gap where sp had no eval."""
+    from tpu_dist.parallel.ring_attention import ring_attention_fn
+
+    model = model_ctor(attn_fn=ring_attention_fn(seq_axis))
+
+    def per_device(params, inputs, targets, valid):
+        seq_idx = jax.lax.axis_index(seq_axis)
+        pos_offset = seq_idx * inputs.shape[1]
+        logits = model.apply({"params": params}, inputs, train=False,
+                             pos_offset=pos_offset)
+        mask = jnp.broadcast_to(valid[:, None], targets.shape).astype(
+            jnp.float32)
+        _, metrics = lm_loss_and_metrics(logits, targets, mask)
+        return jax.tree.map(
+            lambda m: jax.lax.psum(jax.lax.psum(m, seq_axis), data_axis),
+            metrics)
+
+    sharded = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis),
+                  P(data_axis)),
+        out_specs=P(),
+        check_vma=False)
+    return jax.jit(sharded)
 
 
 def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,

@@ -101,20 +101,14 @@ def shard_state_pp(mesh: Mesh, state):
         state, pp_state_specs(state))
 
 
-def make_lm_pp_train_step(model, tx, mesh: Mesh, num_microbatches: int,
-                          data_axis: str = DATA_AXIS,
-                          stage_axis: str = STAGE_AXIS,
-                          donate: bool = True) -> Callable:
-    """GPipe train step: (state, inputs (B,L), targets (B,L), rng) ->
-    (state, metric sums). ``state.params`` must be in pipeline layout
-    (stack_pipeline_params) and placed by shard_state_pp.
-
-    ``model`` is the TransformerLM whose geometry the params came from (its
-    Block/embedding hyperparameters are reused functionally here).
-    """
+def _pp_forward_builder(model, mesh: Mesh, num_microbatches: int,
+                        stage_axis: str = STAGE_AXIS) -> Callable:
+    """Shared pipeline forward for the train AND eval steps: returns
+    ``fwd(params, inputs) -> (logits, is_last)`` to run INSIDE shard_map.
+    ``logits`` are real only on the last stage (``is_last`` bool); other
+    stages carry zeros so their loss and its gradient vanish."""
     import flax.linen as nn
 
-    from tpu_dist.engine.lm_steps import lm_loss_and_metrics
     from tpu_dist.models.transformer import Block
 
     n_stages = mesh.shape[stage_axis]
@@ -133,59 +127,81 @@ def make_lm_pp_train_step(model, tx, mesh: Mesh, num_microbatches: int,
         x, _ = jax.lax.scan(one, x, blocks_local)
         return x
 
-    def per_device(state: TrainState, inputs, targets, rng):
-        del rng  # blocks are dropout-free; kept for engine-signature parity
+    def fwd(params, inputs):
         stage = jax.lax.axis_index(stage_axis)
         b_local, seq_len = inputs.shape
         if b_local % m:
             raise ValueError(f"local batch {b_local} not divisible by "
                              f"{m} microbatches")
         mb = b_local // m
+        eh = params["embed_head"]
+        blocks_local = jax.tree.map(lambda x: x[0], params["blocks"])
+
+        # embedding computed everywhere, consumed only by stage 0 (the
+        # where() below zeroes other stages' gradient contribution)
+        tok = eh["tok_emb"]["embedding"][inputs]          # (B, L, D) f32
+        pos = eh["pos_emb"]["embedding"][
+            jnp.arange(seq_len)][None]
+        emb = (tok + pos).astype(dtype)
+        emb_mb = emb.reshape(m, mb, seq_len, emb.shape[-1])
+
+        zeros_act = jnp.zeros_like(emb_mb[0])
+        zeros_out = jnp.zeros_like(emb_mb)
+        is_last = stage == n_stages - 1
+
+        def tick(carry, t):
+            recv, outs = carry
+            inp = jnp.where(stage == 0,
+                            emb_mb[jnp.clip(t, 0, m - 1)], recv)
+            # stage s works on microbatch t-s; outside [0, M) it's bubble
+            valid = (t - stage >= 0) & (t - stage < m)
+            out = jnp.where(valid, apply_stage(blocks_local, inp), 0.0)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+            outs = jnp.where(
+                is_last & (t >= n_stages - 1),
+                jax.lax.dynamic_update_index_in_dim(outs, out, out_idx, 0),
+                outs)
+            nxt = jax.lax.ppermute(
+                out, stage_axis,
+                [(i, i + 1) for i in range(n_stages - 1)])
+            return (nxt, outs), None
+
+        (_, outs), _ = jax.lax.scan(
+            tick, (zeros_act, zeros_out),
+            jnp.arange(m + n_stages - 1))
+
+        # head on the last stage's collected outputs; other stages carry
+        # zeros and a zero mask, so their loss (and its gradient) is 0
+        x = ln_f.apply({"params": eh["ln_f"]},
+                       outs.reshape(b_local, seq_len, -1))
+        logits = (x.astype(dtype)
+                  @ eh["lm_head"]["kernel"].astype(dtype)
+                  ).astype(jnp.float32)
+        return logits, is_last
+
+    return fwd
+
+
+def make_lm_pp_train_step(model, tx, mesh: Mesh, num_microbatches: int,
+                          data_axis: str = DATA_AXIS,
+                          stage_axis: str = STAGE_AXIS,
+                          donate: bool = True) -> Callable:
+    """GPipe train step: (state, inputs (B,L), targets (B,L), rng) ->
+    (state, metric sums). ``state.params`` must be in pipeline layout
+    (stack_pipeline_params) and placed by shard_state_pp.
+
+    ``model`` is the TransformerLM whose geometry the params came from (its
+    Block/embedding hyperparameters are reused functionally here).
+    """
+    from tpu_dist.engine.lm_steps import lm_loss_and_metrics
+
+    fwd = _pp_forward_builder(model, mesh, num_microbatches, stage_axis)
+
+    def per_device(state: TrainState, inputs, targets, rng):
+        del rng  # blocks are dropout-free; kept for engine-signature parity
 
         def loss_fn(params):
-            eh = params["embed_head"]
-            blocks_local = jax.tree.map(lambda x: x[0], params["blocks"])
-
-            # embedding computed everywhere, consumed only by stage 0 (the
-            # where() below zeroes other stages' gradient contribution)
-            tok = eh["tok_emb"]["embedding"][inputs]          # (B, L, D) f32
-            pos = eh["pos_emb"]["embedding"][
-                jnp.arange(seq_len)][None]
-            emb = (tok + pos).astype(dtype)
-            emb_mb = emb.reshape(m, mb, seq_len, emb.shape[-1])
-
-            zeros_act = jnp.zeros_like(emb_mb[0])
-            zeros_out = jnp.zeros_like(emb_mb)
-            is_last = stage == n_stages - 1
-
-            def tick(carry, t):
-                recv, outs = carry
-                inp = jnp.where(stage == 0,
-                                emb_mb[jnp.clip(t, 0, m - 1)], recv)
-                # stage s works on microbatch t-s; outside [0, M) it's bubble
-                valid = (t - stage >= 0) & (t - stage < m)
-                out = jnp.where(valid, apply_stage(blocks_local, inp), 0.0)
-                out_idx = jnp.clip(t - (n_stages - 1), 0, m - 1)
-                outs = jnp.where(
-                    is_last & (t >= n_stages - 1),
-                    jax.lax.dynamic_update_index_in_dim(outs, out, out_idx, 0),
-                    outs)
-                nxt = jax.lax.ppermute(
-                    out, stage_axis,
-                    [(i, i + 1) for i in range(n_stages - 1)])
-                return (nxt, outs), None
-
-            (_, outs), _ = jax.lax.scan(
-                tick, (zeros_act, zeros_out),
-                jnp.arange(m + n_stages - 1))
-
-            # head on the last stage's collected outputs; other stages carry
-            # zeros and a zero mask, so their loss (and its gradient) is 0
-            x = ln_f.apply({"params": eh["ln_f"]},
-                           outs.reshape(b_local, seq_len, -1))
-            logits = (x.astype(dtype)
-                      @ eh["lm_head"]["kernel"].astype(dtype)
-                      ).astype(jnp.float32)
+            logits, is_last = fwd(params, inputs)
             mask = jnp.where(is_last,
                              jnp.ones(targets.shape, jnp.float32), 0.0)
             loss_sum, metrics = lm_loss_and_metrics(logits, targets, mask)
@@ -220,3 +236,45 @@ def make_lm_pp_train_step(model, tx, mesh: Mesh, num_microbatches: int,
         return sharded(state, inputs, targets, rng)
 
     return jax.jit(call, donate_argnums=(0,) if donate else ())
+
+
+def make_lm_pp_eval_step(model, mesh: Mesh, num_microbatches: int,
+                         data_axis: str = DATA_AXIS,
+                         stage_axis: str = STAGE_AXIS) -> Callable:
+    """Held-out eval through the pipeline: (params, inputs, targets, valid)
+    -> psum'd metric sums. ``valid`` (B,) masks sampler wrap-padding rows;
+    only the last stage's logits are real, so its mask also carries
+    ``is_last`` — the round-2 gap where pp had no eval path."""
+    from tpu_dist.engine.lm_steps import lm_loss_and_metrics
+
+    fwd = _pp_forward_builder(model, mesh, num_microbatches, stage_axis)
+
+    def per_device(params, inputs, targets, valid):
+        logits, is_last = fwd(params, inputs)
+        mask = jnp.where(
+            is_last,
+            jnp.broadcast_to(valid[:, None], targets.shape).astype(
+                jnp.float32),
+            0.0)
+        _, metrics = lm_loss_and_metrics(logits, targets, mask)
+        return jax.tree.map(
+            lambda v: jax.lax.psum(jax.lax.psum(v, stage_axis), data_axis),
+            metrics)
+
+    def call(params, inputs, targets, valid):
+        from jax.tree_util import tree_map_with_path
+
+        def spec(path, leaf):
+            under = any(getattr(k, "key", None) == "blocks" for k in path)
+            return P(STAGE_AXIS, *([None] * (leaf.ndim - 1))) if under else P()
+
+        p_specs = tree_map_with_path(spec, params)
+        sharded = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(p_specs, P(data_axis, None), P(data_axis, None),
+                      P(data_axis)),
+            out_specs=P(),
+            check_vma=False)
+        return sharded(params, inputs, targets, valid)
+
+    return jax.jit(call)

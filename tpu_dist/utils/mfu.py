@@ -1,0 +1,45 @@
+"""MFU accounting: XLA-cost-model FLOPs vs device peak (VERDICT r1 #4).
+
+Shared by bench.py and the LM/image trainers so every throughput number can
+carry a model-FLOPs-utilization figure. Peaks are public bf16 spec-sheet
+numbers per chip; override with BENCH_PEAK_TFLOPS for unlisted devices.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# bf16 peak TFLOP/s per chip by device kind (public spec sheets)
+PEAK_TFLOPS = (
+    ("v6", 918.0), ("trillium", 918.0),
+    ("v5p", 459.0),
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5litepod", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def peak_tflops_for(device) -> float | None:
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in PEAK_TFLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def step_flops(jitted_step, *args) -> float | None:
+    """One step's FLOPs from XLA's cost model (per-device SPMD program);
+    None when the backend doesn't expose cost analysis."""
+    try:
+        cost = jitted_step.lower(*args).compile().cost_analysis()
+        if isinstance(cost, list):  # older API: one dict per device program
+            cost = cost[0]
+        return float(cost["flops"])
+    except Exception as e:
+        print(f"cost_analysis unavailable: {e!r}", file=sys.stderr)
+        return None
