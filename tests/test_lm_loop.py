@@ -147,3 +147,8 @@ def test_lm_max_steps_caps_run():
     cfg = LMConfig(max_steps=3, **TINY)
     tr = _run(cfg)
     assert int(jax.device_get(tr.state.step)) == 3
+    # windowed path: K-step dispatches are atomic, so the window list must
+    # be clipped to the budget — max_steps NOT divisible by K stays exact
+    cfg = LMConfig(max_steps=3, steps_per_dispatch=2, **TINY)
+    tr = _run(cfg)
+    assert int(jax.device_get(tr.state.step)) == 3

@@ -144,7 +144,8 @@ def load_token_dataset(data: str, seq_len: int, vocab_size: int,
         vocab = vocab_size
         name = "synth-affine"
     if val_data and os.path.exists(val_data):
-        val_stream, _ = _load_stream(val_data)
+        val_stream, val_vocab = _load_stream(val_data)
+        vocab = max(vocab, val_vocab)  # val ids must fit the embedding too
         train_stream = stream
     else:
         n_val = max(seq_len + 1, int(len(stream) * val_frac))

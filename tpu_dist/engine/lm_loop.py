@@ -391,6 +391,13 @@ class LMTrainer:
     def _device_windows(self, epoch: int, skip: int, put):
         batches, _ = self._epoch_indices(self.train_ds, True, epoch)
         batches = batches[skip:]
+        if self.cfg.max_steps:
+            # a K-step dispatch is atomic, so clip the window list to the
+            # remaining step budget — otherwise the windowed path would
+            # overshoot max_steps by up to K-1 optimizer steps
+            remaining = self.cfg.max_steps - \
+                (epoch * self.steps_per_epoch + skip)
+            batches = batches[:max(remaining, 0)]
         return [(len(w), put(np.ascontiguousarray(w)))
                 for w in (batches[i:i + self.k]
                           for i in range(0, len(batches), self.k))]
@@ -497,10 +504,16 @@ class LMTrainer:
         if self._flops_per_step is None:
             idx, _ = self._epoch_indices(self.train_ds, True, 0)
             if self.device_data:
+                # SAME (K, B) window shape as training, so the lowering hits
+                # the already-compiled executable instead of building a
+                # second K=1 variant. XLA's cost model counts a lax.scan
+                # body ONCE regardless of trip count (verified; bench.py
+                # documents the same), so this IS the per-step figure.
+                k = min(self.k, len(idx))
                 win_sh = NamedSharding(self.mesh, P(None, "data"))
                 args = (self.state, self._train_rows_dev,
                         assemble_global(win_sh, np.ascontiguousarray(
-                            idx[:1])), self.rng)
+                            idx[:k])), self.rng)
                 f = step_flops(self.window_step, *args)
             else:
                 sh = NamedSharding(self.mesh, self.data_spec)

@@ -77,15 +77,11 @@ def make_lm_batches(tokens: np.ndarray):
     return tokens[:, :-1], tokens[:, 1:]
 
 
-def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
-                       aux_weight: float = 0.01,
-                       donate: bool = True) -> Callable:
-    """jit step for DP — and for DP x TP / FSDP / EP when the TrainState was
-    placed with the matching sharding helper (GSPMD propagates the param
-    layout and emits the collectives; the step code is identical).
-    ``aux_weight`` scales any sown MoE load-balancing losses."""
-    repl = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, P(data_axis))
+def _lm_step_fn(model, tx, aux_weight: float) -> Callable:
+    """THE pure LM train step shared by every jit wrapper (single-batch and
+    indexed-window) — the lm twin of steps.py _train_step_fn, so the
+    windowed path's 'identical math to K sequential steps' contract is
+    enforced structurally, not by parallel copies."""
 
     def step(state: TrainState, inputs, targets, rng):
         dropout_rng = jax.random.fold_in(rng, state.step)
@@ -101,10 +97,24 @@ def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
             loss_fn, has_aux=True)(state.params)
         return _apply_update(tx, state, grads, stats, metrics)
 
+    return step
+
+
+def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
+                       aux_weight: float = 0.01,
+                       donate: bool = True) -> Callable:
+    """jit step for DP — and for DP x TP / FSDP / EP when the TrainState was
+    placed with the matching sharding helper (GSPMD propagates the param
+    layout and emits the collectives; the step code is identical).
+    ``aux_weight`` scales any sown MoE load-balancing losses."""
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(data_axis))
+
     # With TP the state arrives pre-sharded (tpu_dist.parallel.tp.shard_lm_params)
     # and in_shardings=None lets GSPMD propagate that layout through the step;
     # pure DP states arrive replicated — same jit serves both.
-    return jax.jit(step, in_shardings=(None, batch_sh, batch_sh, repl),
+    return jax.jit(_lm_step_fn(model, tx, aux_weight),
+                   in_shardings=(None, batch_sh, batch_sh, repl),
                    out_shardings=None,
                    donate_argnums=(0,) if donate else ())
 
@@ -148,20 +158,7 @@ def make_lm_indexed_multi_train_step(model, tx, mesh: Mesh,
     """
     repl = NamedSharding(mesh, P())
     idx_sh = NamedSharding(mesh, P(None, data_axis))
-
-    def one_step(state, inputs, targets, rng):
-        dropout_rng = jax.random.fold_in(rng, state.step)
-
-        def loss_fn(p):
-            logits, aux = _apply_collect_aux(model, p, inputs, dropout_rng)
-            mask = jnp.ones(targets.shape, jnp.float32)
-            loss_sum, metrics = lm_loss_and_metrics(logits, targets, mask)
-            mean = loss_sum / jnp.maximum(metrics["count"], 1.0)
-            return mean + aux_weight * aux, ({}, metrics)
-
-        (_, (stats, metrics)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
-        return _apply_update(tx, state, grads, stats, metrics)
+    one_step = _lm_step_fn(model, tx, aux_weight)
 
     def multi(state: TrainState, rows_all, idx, rng):
         def body(st, idx_b):

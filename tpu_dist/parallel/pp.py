@@ -78,16 +78,16 @@ def unstack_pipeline_params(pp_params):
     return out
 
 
-def pp_state_specs(state) -> TrainState:
-    """PartitionSpec pytree for a TrainState holding pipeline-layout params
-    (and an opt_state mirroring them): 'blocks' subtrees P('stage'), the
-    rest replicated."""
+def pp_state_specs(state, stage_axis: str = STAGE_AXIS) -> TrainState:
+    """PartitionSpec pytree for a pipeline-layout tree (a TrainState, or a
+    bare params dict — the rule is structural): 'blocks' subtrees
+    P(stage_axis), the rest replicated."""
     from jax.tree_util import tree_map_with_path
 
     def spec(path, leaf):
         under_blocks = any(getattr(k, "key", None) == "blocks" for k in path)
         if under_blocks:
-            return P(STAGE_AXIS, *([None] * (leaf.ndim - 1)))
+            return P(stage_axis, *([None] * (leaf.ndim - 1)))
         return P()
 
     return tree_map_with_path(spec, state)
@@ -262,13 +262,7 @@ def make_lm_pp_eval_step(model, mesh: Mesh, num_microbatches: int,
             metrics)
 
     def call(params, inputs, targets, valid):
-        from jax.tree_util import tree_map_with_path
-
-        def spec(path, leaf):
-            under = any(getattr(k, "key", None) == "blocks" for k in path)
-            return P(STAGE_AXIS, *([None] * (leaf.ndim - 1))) if under else P()
-
-        p_specs = tree_map_with_path(spec, params)
+        p_specs = pp_state_specs(params, stage_axis)
         sharded = shard_map(
             per_device, mesh=mesh,
             in_specs=(p_specs, P(data_axis, None), P(data_axis, None),
