@@ -47,6 +47,7 @@ def main() -> None:
         workers=1, print_freq=100, seed=0, synth_train_size=64,
         synth_val_size=32, checkpoint_dir=os.path.join(out, "ckpt"),
         variant=os.environ.get("TPU_DIST_TEST_VARIANT", "jit"),
+        grad_compression=os.environ.get("TPU_DIST_TEST_COMPRESSION", "none"),
         steps_per_dispatch=int(os.environ.get("TPU_DIST_TEST_K", "1")))
     trainer = Trainer(cfg)
     best = trainer.fit()

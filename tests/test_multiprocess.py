@@ -181,7 +181,8 @@ def test_multiprocess_shard_map_engine_matches_single(tmp_path):
     """The explicit-collective (horovod-equivalent) image engine across 2
     real processes == single process — the shard_map psum path over a real
     boundary, with bf16 gradient compression on."""
-    env = {"TPU_DIST_TEST_VARIANT": "shard_map"}
+    env = {"TPU_DIST_TEST_VARIANT": "shard_map",
+           "TPU_DIST_TEST_COMPRESSION": "bf16"}
     single = run_workers(str(tmp_path), "sm-single", nprocs=1,
                          local_devices=4, extra_env=env)
     multi = run_workers(str(tmp_path), "sm-multi", nprocs=2,

@@ -136,7 +136,12 @@ class LMConfig:
     mesh_shape: Optional[Sequence[int]] = None
     mesh_axes: Sequence[str] = ("data",)
     fsdp: bool = False             # ZeRO-3 param+opt sharding over 'data'
-    pp_microbatches: int = 4       # GPipe microbatches (with a 'stage' axis)
+    pp_microbatches: int = 4       # pipeline microbatches (with a 'stage' axis)
+    pp_schedule: str = "gpipe"     # gpipe (autodiff through the tick scan;
+                                   # stashes O(M) microbatch activations) |
+                                   # 1f1b (manual-vjp PipeDream-flush:
+                                   # activation stash O(S), M-independent —
+                                   # the large-M / long-context schedule)
 
     # -- dispatch/data path (same TPU levers as TrainConfig)
     steps_per_dispatch: int = 1

@@ -73,7 +73,7 @@ class LMTrainer:
         self.use_ep = "expert" in names and shape["expert"] > 1
         self.use_pp = "stage" in names and shape["stage"] > 1
         self._validate_mode()
-        self.mode = ("pp-gpipe" if self.use_pp else
+        self.mode = (f"pp-{cfg.pp_schedule}" if self.use_pp else
                      "sp-ring" if self.use_sp else
                      "ep-moe" if self.use_ep else
                      "tp" if self.use_tp else
@@ -263,9 +263,16 @@ class LMTrainer:
     def _build_steps(self):
         cfg = self.cfg
         if self.use_pp:
-            from tpu_dist.parallel.pp import (make_lm_pp_eval_step,
+            from tpu_dist.parallel.pp import (make_lm_pp_1f1b_train_step,
+                                              make_lm_pp_eval_step,
                                               make_lm_pp_train_step)
-            self.train_step = make_lm_pp_train_step(
+            if cfg.pp_schedule not in ("gpipe", "1f1b"):
+                raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r} "
+                                 "(gpipe|1f1b)")
+            make_pp = (make_lm_pp_1f1b_train_step
+                       if cfg.pp_schedule == "1f1b"
+                       else make_lm_pp_train_step)
+            self.train_step = make_pp(
                 self.model, self.tx, self.mesh, cfg.pp_microbatches)
             self.eval_step = make_lm_pp_eval_step(
                 self.model, self.mesh, cfg.pp_microbatches)
