@@ -57,6 +57,114 @@ ARCH = os.environ.get("BENCH_ARCH", "resnet50")
 NUM_CLASSES = int(os.environ.get("BENCH_NUM_CLASSES", "10"))
 
 
+def lm_bench():
+    """BENCH_ARCH=transformer_lm: tokens/sec/chip + MFU for the LM engine.
+
+    Drives the SAME windowed HBM-resident path LMTrainer trains with
+    (make_lm_indexed_multi_train_step): K optimizer steps per dispatch over
+    device-resident rows, bf16 compute, flash attention. Knobs:
+    BENCH_SEQ_LEN (2048), BENCH_D_MODEL (1024), BENCH_LAYERS (8),
+    BENCH_HEADS (8), BENCH_VOCAB (32000), BENCH_LM_BATCH per chip (8),
+    BENCH_ATTN full|blockwise|flash (flash), BENCH_REMAT=1.
+    Completion is forced with a device_get readback (block_until_ready does
+    not reliably block across tunneled controllers); the ~0.1s readback is
+    amortized over the multi-second window.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_dist.engine.lm_steps import make_lm_indexed_multi_train_step
+    from tpu_dist.engine.state import TrainState
+    from tpu_dist.models.transformer import TransformerLM, full_attention
+    from tpu_dist.ops import make_optimizer
+    from tpu_dist.parallel.mesh import make_mesh, replicated
+    from tpu_dist.utils.mfu import peak_tflops_for, step_flops
+
+    n_chips = jax.device_count()
+    L = int(os.environ.get("BENCH_SEQ_LEN", "2048"))
+    d_model = int(os.environ.get("BENCH_D_MODEL", "1024"))
+    layers = int(os.environ.get("BENCH_LAYERS", "8"))
+    heads = int(os.environ.get("BENCH_HEADS", "8"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "32000"))
+    batch = int(os.environ.get("BENCH_LM_BATCH", "8")) * n_chips
+    attn_kind = os.environ.get("BENCH_ATTN", "flash")
+    k = int(os.environ.get("BENCH_STEPS_PER_WINDOW",
+                           os.environ.get("BENCH_STEPS", "20")))
+    trials = int(os.environ.get("BENCH_TRIALS", "3"))
+
+    if attn_kind == "flash":
+        from tpu_dist.ops.flash_attention import flash_attention_fn
+        attn_fn = flash_attention_fn()
+    elif attn_kind == "blockwise":
+        from tpu_dist.ops.flash_attention import blockwise_attention_fn
+        attn_fn = blockwise_attention_fn(512)
+    else:
+        attn_fn = full_attention
+    mesh = make_mesh()
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=layers, d_model=d_model,
+        num_heads=heads, max_len=L, dtype=jnp.bfloat16, attn_fn=attn_fn,
+        remat=os.environ.get("BENCH_REMAT") == "1")
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, L), np.int32), train=False)["params"]
+    tx = make_optimizer(1e-3, 0.9, 0.0, steps_per_epoch=10 ** 6)
+    state = jax.device_put(TrainState.create(params, {}, tx),
+                           replicated(mesh))
+    window = make_lm_indexed_multi_train_step(model, tx, mesh)
+
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, vocab, (batch, L + 1)).astype(np.int32)
+    rows_dev = jax.device_put(rows, replicated(mesh))
+    idx = np.tile(np.arange(batch, dtype=np.int32), (k, 1))
+    idx_dev = jax.device_put(idx, NamedSharding(mesh, P(None, "data")))
+    key = jax.random.PRNGKey(1)
+
+    # ANALYTICAL model FLOPs per token (the standard MFU accounting:
+    # 6*N_non-embedding + 6*layers*L*d for causal attention, fwd+bwd).
+    # XLA's cost model is wrong here twice over: it counts lax.scan bodies
+    # once regardless of trip count, and the Pallas flash kernel is a custom
+    # call it cannot cost at all — so flash runs would report ~25% low and
+    # not be comparable to full-attention runs.
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    n_embed = sum(int(np.prod(params[k]["embedding"].shape))
+                  for k in ("tok_emb", "pos_emb"))
+    flops_per_token = 6 * (n_params - n_embed) + 6 * layers * L * d_model
+    xla_flops = step_flops(window, state, rows_dev, idx_dev, key)
+    if xla_flops:
+        print(f"xla cost model (diagnostic only): "
+              f"{xla_flops / (batch * L / n_chips) / 1e6:.2f} MFLOP/token vs "
+              f"analytical {flops_per_token / 1e6:.2f}", file=sys.stderr)
+    state, m = window(state, rows_dev, idx_dev, key)           # compile+warm
+    jax.device_get(m)
+    rates = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        state, m = window(state, rows_dev, idx_dev, key)
+        jax.device_get(m)  # forces completion through the tunnel
+        rates.append(k * batch * L / (time.perf_counter() - t0))
+    best = max(rates)
+    tok_chip = best / n_chips
+    peak = peak_tflops_for(jax.devices()[0])
+    tflops = tok_chip * flops_per_token / 1e12
+    mfu = tflops / peak if peak else None
+    print(f"lm {layers}L/d{d_model} L={L} b/chip={batch // n_chips} "
+          f"attn={attn_kind}: {tok_chip:,.0f} tok/s/chip, trials "
+          f"{[round(r / n_chips) for r in rates]}"
+          + (f", {tflops:.1f} TFLOP/s/chip" if tflops else "")
+          + (f", MFU {mfu * 100:.1f}% of {peak} TF peak" if mfu else ""),
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": f"lm_{layers}l_d{d_model}_seq{L}_tokens_per_sec_per_chip",
+        "value": round(tok_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+        "mfu": round(mfu, 4) if mfu else None,
+        "tflops": round(tflops, 2) if tflops else None,
+    }))
+
+
 def build(model_kwargs, batch, k):
     import jax
     import jax.numpy as jnp
@@ -136,6 +244,11 @@ def main():
     jax.config.update("jax_compilation_cache_dir",
                       os.environ.get("JAX_CACHE_DIR", "/tmp/jaxcache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+    from tpu_dist.models.registry import model_kind
+    if model_kind(ARCH) == "lm":
+        lm_bench()
+        return
 
     n_chips = jax.device_count()
     per_chip_batch = int(os.environ.get("BENCH_PER_CHIP_BATCH", "1024"))

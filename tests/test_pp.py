@@ -53,12 +53,22 @@ def test_blocks_not_divisible_raises():
         stack_pipeline_params(params, num_stages=3)
 
 
+def _maker(schedule):
+    if schedule == "1f1b":
+        from tpu_dist.parallel.pp import make_lm_pp_1f1b_train_step
+        return make_lm_pp_1f1b_train_step
+    return make_lm_pp_train_step
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 @pytest.mark.parametrize("mesh_shape,axes,microbatches", [
     ((1, 4), ("data", "stage"), 4),   # pure pipeline
     ((2, 4), ("data", "stage"), 2),   # dp x pp
     ((2, 2), ("data", "stage"), 4),   # 2 blocks per stage
 ])
-def test_pp_step_matches_dp(mesh_shape, axes, microbatches):
+def test_pp_step_matches_dp(mesh_shape, axes, microbatches, schedule):
+    """Either pipeline schedule == plain DP, loss/metrics/params — a
+    schedule changes WHEN microbatches run, never what is computed."""
     lm, params, tx, inputs, targets = _setup()
     key = jax.random.PRNGKey(1)
 
@@ -76,7 +86,7 @@ def test_pp_step_matches_dp(mesh_shape, axes, microbatches):
     mesh = make_mesh(mesh_shape, axes, devices=jax.devices()[:ndev])
     pp_params = stack_pipeline_params(params, num_stages=mesh.shape["stage"])
     st_pp = shard_state_pp(mesh, TrainState.create(pp_params, {}, tx))
-    pp_step = make_lm_pp_train_step(lm, tx, mesh, microbatches, donate=False)
+    pp_step = _maker(schedule)(lm, tx, mesh, microbatches, donate=False)
     sh_pp = jax.sharding.NamedSharding(
         mesh, jax.sharding.PartitionSpec("data", None))
     st_pp, m_pp = pp_step(st_pp, jax.device_put(inputs, sh_pp),
@@ -120,53 +130,6 @@ def test_pp_multiple_steps_converge():
                       / float(jax.device_get(m["count"])))
     assert losses[-1] < losses[0] * 0.85, losses
     assert losses == sorted(losses, reverse=True), losses  # monotone descent
-
-
-@pytest.mark.parametrize("mesh_shape,axes,microbatches", [
-    ((1, 4), ("data", "stage"), 4),
-    ((2, 4), ("data", "stage"), 2),
-    ((2, 2), ("data", "stage"), 4),
-])
-def test_pp_1f1b_matches_dp(mesh_shape, axes, microbatches):
-    """The manual-vjp 1F1B schedule == plain DP, loss/metrics/params —
-    schedule changes WHEN microbatches run, never what is computed."""
-    from tpu_dist.parallel.pp import make_lm_pp_1f1b_train_step
-
-    lm, params, tx, inputs, targets = _setup()
-    key = jax.random.PRNGKey(1)
-
-    mesh_dp = make_mesh((1,), ("data",), devices=jax.devices()[:1])
-    st_dp = jax.device_put(TrainState.create(params, {}, tx),
-                           replicated(mesh_dp))
-    dp_step = make_lm_train_step(lm, tx, mesh_dp, donate=False)
-    sh = jax.sharding.NamedSharding(mesh_dp, jax.sharding.PartitionSpec("data"))
-    st_dp, m_dp = dp_step(st_dp, jax.device_put(inputs, sh),
-                          jax.device_put(targets, sh), key)
-
-    ndev = int(np.prod(mesh_shape))
-    mesh = make_mesh(mesh_shape, axes, devices=jax.devices()[:ndev])
-    pp_params = stack_pipeline_params(params, num_stages=mesh.shape["stage"])
-    st_pp = shard_state_pp(mesh, TrainState.create(pp_params, {}, tx))
-    step = make_lm_pp_1f1b_train_step(lm, tx, mesh, microbatches,
-                                      donate=False)
-    sh_pp = jax.sharding.NamedSharding(
-        mesh, jax.sharding.PartitionSpec("data", None))
-    st_pp, m_pp = step(st_pp, jax.device_put(inputs, sh_pp),
-                       jax.device_put(targets, sh_pp), key)
-
-    for k in ("loss_sum", "correct1", "count"):
-        assert float(jax.device_get(m_pp[k])) == pytest.approx(
-            float(jax.device_get(m_dp[k])), rel=1e-5), k
-    back = unstack_pipeline_params(jax.device_get(st_pp.params))
-    flat_dp = {jax.tree_util.keystr(p): v for p, v in
-               jax.tree_util.tree_leaves_with_path(jax.device_get(st_dp.params))}
-    flat_pp = {jax.tree_util.keystr(p): v for p, v in
-               jax.tree_util.tree_leaves_with_path(back)}
-    assert flat_dp.keys() == flat_pp.keys()
-    for path in flat_dp:
-        np.testing.assert_allclose(
-            np.asarray(flat_dp[path]), np.asarray(flat_pp[path]),
-            rtol=2e-5, atol=1e-7, err_msg=str(path))
 
 
 def test_pp_1f1b_activation_memory_independent_of_microbatches():

@@ -506,8 +506,28 @@ class LMTrainer:
 
     # ------------------------------------------------------------------
     def _mfu(self, tok_per_sec: float):
-        """(tflops, mfu) from XLA cost analysis; (None, None) off-TPU."""
+        """(tflops, mfu). Dense LMs use the ANALYTICAL model-FLOPs formula
+        (6*N_non-embed + 6*layers*L*d, fwd+bwd, causal) — XLA's cost model
+        counts scan bodies once and cannot cost Pallas custom calls, so it
+        understates flash runs. MoE falls back to the XLA cost model."""
         from tpu_dist.utils.mfu import peak_tflops_for, step_flops
+        cfg = self.cfg
+        if self._flops_per_step is None and not cfg.num_experts:
+            params = self.state.params
+            leaves = jax.tree_util.tree_leaves(params)
+            n_params = sum(int(np.prod(x.shape)) for x in leaves)
+            n_embed = 0
+            flat = {jax.tree_util.keystr(p): v for p, v in
+                    jax.tree_util.tree_leaves_with_path(params)}
+            for k, v in flat.items():
+                if "tok_emb" in k or "pos_emb" in k:
+                    n_embed += int(np.prod(v.shape))
+            per_token = (6 * (n_params - n_embed)
+                         + 6 * cfg.num_layers * cfg.seq_len * cfg.d_model)
+            ndev = self.mesh.devices.size
+            # stored per-device-program per-step, like the XLA path below
+            self._flops_per_step = per_token * cfg.batch_size * \
+                cfg.seq_len / ndev
         if self._flops_per_step is None:
             idx, _ = self._epoch_indices(self.train_ds, True, 0)
             if self.device_data:
