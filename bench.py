@@ -80,7 +80,15 @@ def lm_bench():
     from tpu_dist.models.transformer import TransformerLM, full_attention
     from tpu_dist.ops import make_optimizer
     from tpu_dist.parallel.mesh import make_mesh, replicated
-    from tpu_dist.utils.mfu import peak_tflops_for, step_flops
+    from tpu_dist.utils.mfu import (lm_flops_per_token, peak_tflops_for,
+                                    step_flops)
+
+    if ARCH != "transformer_lm":
+        raise SystemExit(
+            f"BENCH_ARCH={ARCH}: the LM bench drives the dense "
+            "TransformerLM only (its analytical MFU accounting assumes "
+            "dense); use BENCH_ARCH=transformer_lm with BENCH_* geometry "
+            "knobs")
 
     n_chips = jax.device_count()
     L = int(os.environ.get("BENCH_SEQ_LEN", "2048"))
@@ -121,16 +129,9 @@ def lm_bench():
     idx_dev = jax.device_put(idx, NamedSharding(mesh, P(None, "data")))
     key = jax.random.PRNGKey(1)
 
-    # ANALYTICAL model FLOPs per token (the standard MFU accounting:
-    # 6*N_non-embedding + 6*layers*L*d for causal attention, fwd+bwd).
-    # XLA's cost model is wrong here twice over: it counts lax.scan bodies
-    # once regardless of trip count, and the Pallas flash kernel is a custom
-    # call it cannot cost at all — so flash runs would report ~25% low and
-    # not be comparable to full-attention runs.
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
-    n_embed = sum(int(np.prod(params[k]["embedding"].shape))
-                  for k in ("tok_emb", "pos_emb"))
-    flops_per_token = 6 * (n_params - n_embed) + 6 * layers * L * d_model
+    # analytical model FLOPs (tpu_dist.utils.mfu.lm_flops_per_token; XLA's
+    # cost model undercounts scan bodies and cannot cost Pallas kernels)
+    flops_per_token = lm_flops_per_token(params, layers, L, d_model)
     xla_flops = step_flops(window, state, rows_dev, idx_dev, key)
     if xla_flops:
         print(f"xla cost model (diagnostic only): "

