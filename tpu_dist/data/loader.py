@@ -91,6 +91,51 @@ class DataLoader:
             stop.set()
 
 
+def stream_prefetch(iterable, depth: int = 2):
+    """Bounded background pipeline over ANY iterable: items are produced —
+    including any host-side assembly and async device-transfer dispatch the
+    iterable performs — in a producer thread while the consumer computes,
+    with at most ``depth`` items staged. The generic engine behind the
+    trainers' streamed host->device window paths (datasets too large for
+    HBM residency); exceptions propagate to the consumer, and abandoning
+    the generator stops the producer."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in iterable:
+                if not _put(item):
+                    return
+            _put(None)
+        except BaseException as e:  # surface assembly/upload errors
+            _put(e)
+
+    threading.Thread(target=producer, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
 def assemble_global(sharding, batch):
     """Device-put a host batch (array or tuple of arrays) onto ``sharding``.
 

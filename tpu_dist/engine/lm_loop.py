@@ -375,14 +375,23 @@ class LMTrainer:
         skip = self._skip_batches
         self._skip_batches = 0
         sh = NamedSharding(self.mesh, self.data_spec)
+
+        def batches():
+            # row gather + shift + upload dispatch, run in the prefetch
+            # thread so assembly never stalls the dispatch loop
+            for j in range(skip, nb):
+                rows = self.train_ds.get_rows(idx[j])
+                inputs, targets = make_lm_batches(rows)
+                yield (j,
+                       assemble_global(sh, np.ascontiguousarray(inputs)),
+                       assemble_global(sh, np.ascontiguousarray(targets)))
+
+        from tpu_dist.data.loader import stream_prefetch
         pending = []
         warm_secs, warm_batches = 0.0, 0
+        i = skip - 1
         end = time.time()
-        for i in range(skip, nb):
-            rows = self.train_ds.get_rows(idx[i])
-            inputs, targets = make_lm_batches(rows)
-            inputs_d = assemble_global(sh, np.ascontiguousarray(inputs))
-            targets_d = assemble_global(sh, np.ascontiguousarray(targets))
+        for i, inputs_d, targets_d in stream_prefetch(batches()):
             meters.update("Data", time.time() - end)
             self.state, metrics = self.train_step(
                 self.state, inputs_d, targets_d, self.rng)

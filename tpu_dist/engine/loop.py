@@ -406,6 +406,18 @@ class Trainer:
         return (np.asarray(idx[:n], np.int32).reshape(shape),
                 np.asarray(valid[:n], np.float32).reshape(shape))
 
+    def _streamed_host_windows(self, loader, skip: int, put):
+        """(n, device window) items via a BOUNDED background pipeline
+        (tpu_dist.data.loader.stream_prefetch): the producer thread
+        assembles window w+1's uint8 batches and dispatches their
+        host->device upload while window w trains — the epoch-prefetch
+        trick (device mode's index uploads) applied to pixel windows, for
+        datasets too large for HBM residency (ImageNet-224 scale)."""
+        from tpu_dist.data.loader import stream_prefetch
+
+        return stream_prefetch(
+            (n, put(p)) for n, p in self._host_windows(loader, skip))
+
     def _device_windows(self, epoch: int, skip: int, put):
         """(K,B) index windows for the HBM-resident dataset, already ON
         device. The transfers are dispatched asynchronously here, so calling
@@ -447,7 +459,7 @@ class Trainer:
                 return self.window_step(state, *dev_payload, self.rng)
 
             loader = self._loader(self.train_ds, True, epoch)
-            windows = ((n, put(p)) for n, p in self._host_windows(loader, skip))
+            windows = self._streamed_host_windows(loader, skip, put)
 
         pending = []  # window metric sums awaiting the next print boundary
         done = skip
