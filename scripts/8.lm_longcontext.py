@@ -12,7 +12,9 @@ flags:
   --mesh data=2,seq=4           sequence parallel: ring attention over 'seq'
   --mesh data=4,model=2         tensor parallel: Megatron shardings via GSPMD
   --mesh data=2,expert=4        MoE expert parallelism (with --num-experts)
-  --mesh data=2,stage=4         pipeline parallel: GPipe microbatches
+  --mesh data=2,stage=4         pipeline parallel (--pp-schedule gpipe|1f1b)
+  --mesh data=2,stage=2,model=2 pipeline x tensor parallel (Megatron inside
+                                each stage via a GSPMD auto axis)
 
 Data: --data points at a token file (.bin uint16 / .npy, nanoGPT-style);
 absent, a deterministic synthetic affine corpus is generated so the loss

@@ -64,8 +64,11 @@ class TrainConfig:
     mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) dp; (4,2) dp x model
     mesh_axes: Sequence[str] = ("data",)
     gradient_predivide_factor: float = 1.0      # reference 5.2...py:185
-    adasum: bool = False                        # reference 5.2...py:184 (mapped to
-                                                # plain mean on TPU; doc'd delta)
+    adasum: bool = False                        # reference 5.2...py:184: REAL
+                                                # Adasum recursive-halving
+                                                # reduction (collectives.
+                                                # adasum_reduce) in the
+                                                # shard_map engine
 
     # -- dispatch/data-path tuning (TPU-only; no reference analog — its
     #    per-batch host loop was the bottleneck the prefetcher fought, C13)

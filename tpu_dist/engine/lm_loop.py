@@ -73,7 +73,9 @@ class LMTrainer:
         self.use_ep = "expert" in names and shape["expert"] > 1
         self.use_pp = "stage" in names and shape["stage"] > 1
         self._validate_mode()
-        self.mode = (f"pp-{cfg.pp_schedule}" if self.use_pp else
+        self.mode = (f"pp-{cfg.pp_schedule}"
+                     + ("+tp" if self.use_pp and self.use_tp else "")
+                     if self.use_pp else
                      "sp-ring" if self.use_sp else
                      "ep-moe" if self.use_ep else
                      "tp" if self.use_tp else
@@ -210,8 +212,10 @@ class LMTrainer:
         cfg = self.cfg
         multi = [a for a in ("seq", "model", "expert", "stage")
                  if a in self.mesh.axis_names and self.mesh.shape[a] > 1]
-        if len(multi) > 1:
-            raise ValueError(f"one model-parallel axis at a time, got {multi}")
+        if len(multi) > 1 and set(multi) != {"stage", "model"}:
+            raise ValueError(
+                f"unsupported model-parallel axis combination {multi} "
+                "(one axis at a time, or stage+model for pp x tp)")
         if self.use_pp and (cfg.num_experts or cfg.fsdp):
             raise ValueError("a 'stage' mesh axis composes only with 'data' "
                              "(GPipe over dense TransformerLM blocks)")

@@ -128,6 +128,13 @@ class Trainer:
             raise ValueError("grad_accum_steps must be >= 1")
         if self.accum > 1 and cfg.variant != "jit":
             raise ValueError("grad_accum_steps > 1 requires variant='jit'")
+        if cfg.adasum and cfg.variant != "shard_map":
+            # the Adasum operator lives in the explicit-collective engine;
+            # silently averaging instead would misreport the run's math
+            raise ValueError("adasum requires variant='shard_map'")
+        if cfg.adasum and cfg.grad_compression != "none":
+            raise ValueError("adasum replaces the compressed-mean allreduce; "
+                             "use grad_compression='none' with it")
         if self.accum > 1 and cfg.steps_per_dispatch > 1:
             raise ValueError("grad_accum_steps and steps_per_dispatch > 1 "
                              "are mutually exclusive")
@@ -144,7 +151,8 @@ class Trainer:
             self.train_step = make_shard_map_train_step(
                 self.model, self.tx, self.transform, self.mesh,
                 grad_compression=cfg.grad_compression,
-                predivide_factor=cfg.gradient_predivide_factor)
+                predivide_factor=cfg.gradient_predivide_factor,
+                adasum=cfg.adasum)
         else:
             self.train_step = make_train_step(
                 self.model, self.tx, self.transform, self.mesh)

@@ -335,13 +335,18 @@ def make_shard_map_train_step(model, tx, transform, mesh: Mesh,
                               data_axis: str = DATA_AXIS,
                               grad_compression: str = "none",
                               predivide_factor: float = 1.0,
+                              adasum: bool = False,
                               donate: bool = True) -> Callable:
     """Explicit-collective step (horovod-equivalent, reference variant 5).
 
     Per-device program via shard_map; gradient averaging is an explicit psum
     with optional bf16 payload compression (reference 5.horovod_distributed.py:
     123-125) and horovod's gradient_predivide_factor placement (pre-scale
-    before summation, post-scale after; reference 5.2...py:185).
+    before summation, post-scale after; reference 5.2...py:185). With
+    ``adasum=True`` the mean is replaced by the Adasum recursive-halving
+    operator (hvd.Adasum, reference 5.2...py:184 —
+    tpu_dist.parallel.collectives.adasum_reduce); predivide/compression are
+    mean-path knobs and do not apply.
     """
     repl = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(data_axis))
@@ -357,14 +362,19 @@ def make_shard_map_train_step(model, tx, transform, mesh: Mesh,
                                         state.loss_scale, True),
             has_aux=True)
         (_, (new_stats, metrics)), grads = grad_fn(state.params)
-        # horovod-style allreduce: predivide -> (compress) -> psum -> postdivide
-        pre = predivide_factor if predivide_factor != 1.0 else nrep
-        grads = jax.tree.map(lambda g: g / pre, grads)
-        down, up = compress_grads(grads, grad_compression)
-        down = jax.tree.map(lambda g: jax.lax.psum(g, data_axis), down)
-        grads = up(down)
-        if predivide_factor != 1.0:
-            grads = jax.tree.map(lambda g: g * (predivide_factor / nrep), grads)
+        if adasum:
+            from tpu_dist.parallel.collectives import adasum_reduce
+            grads = adasum_reduce(grads, data_axis, nrep)
+        else:
+            # horovod allreduce: predivide -> (compress) -> psum -> postdivide
+            pre = predivide_factor if predivide_factor != 1.0 else nrep
+            grads = jax.tree.map(lambda g: g / pre, grads)
+            down, up = compress_grads(grads, grad_compression)
+            down = jax.tree.map(lambda g: jax.lax.psum(g, data_axis), down)
+            grads = up(down)
+            if predivide_factor != 1.0:
+                grads = jax.tree.map(lambda g: g * (predivide_factor / nrep),
+                                     grads)
         # per-replica BN stats -> pmean (≈ horovod local BN + periodic sync)
         new_stats = jax.tree.map(lambda s: jax.lax.pmean(s, data_axis), new_stats)
         metrics = jax.tree.map(lambda m: jax.lax.psum(m, data_axis), metrics)

@@ -69,6 +69,50 @@ def compress_grads(tree, compression: str = "none"):
     raise ValueError(f"unknown grad compression {compression!r}")
 
 
+def adasum_reduce(tree, axis_name: str = DATA_AXIS, axis_size: int = None):
+    """Adasum gradient reduction (hvd.Adasum, reference 5.2...py:184).
+
+    Recursive-halving over ``axis_name``: log2(N) rounds in which partner
+    pairs exchange their partial reductions via ppermute and combine with
+
+        adasum(a, b) = (1 - <a,b> / (2|a|^2)) a + (1 - <a,b> / (2|b|^2)) b
+
+    — orthogonal gradients ADD (descent progress keeps both directions),
+    parallel identical gradients AVERAGE (no double-stepping), the scale-
+    robust middle ground Adasum was built for. The inner products span the
+    WHOLE flattened gradient, matching Horovod's single-tensor semantics.
+    Requires a power-of-two axis size (the recursive-halving exchange
+    pattern); the formula is symmetric, so both partners compute the same
+    combined value and no broadcast round is needed.
+    """
+    import math as _math
+
+    n = axis_size if axis_size is not None else jax.lax.axis_size(axis_name)
+    if n & (n - 1):
+        raise ValueError(f"adasum needs a power-of-two axis size, got {n}")
+
+    def dot(t1, t2):
+        return sum(jnp.sum(a.astype(jnp.float32) * b.astype(jnp.float32))
+                   for a, b in zip(jax.tree.leaves(t1), jax.tree.leaves(t2)))
+
+    a = tree
+    for k in range(int(_math.log2(n))):
+        stride = 1 << k
+        perm = [(i, i ^ stride) for i in range(n)]
+        b = jax.tree.map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), a)
+        ab = dot(a, b)
+        na = jnp.maximum(dot(a, a), 1e-30)
+        nb = jnp.maximum(dot(b, b), 1e-30)
+        wa = 1.0 - ab / (2.0 * na)
+        wb = 1.0 - ab / (2.0 * nb)
+        a = jax.tree.map(
+            lambda x, y: (wa * x.astype(jnp.float32)
+                          + wb * y.astype(jnp.float32)).astype(x.dtype),
+            a, b)
+    return a
+
+
 # ---- host-level barrier ----------------------------------------------------
 
 def barrier(mesh: Mesh | None = None) -> None:

@@ -98,12 +98,63 @@ def pp_state_specs(state, stage_axis: str = STAGE_AXIS) -> TrainState:
     return tree_map_with_path(spec, state)
 
 
-def shard_state_pp(mesh: Mesh, state):
+def pp_tp_placement_specs(state, stage_axis: str = STAGE_AXIS,
+                          model_axis: str = "model"):
+    """PLACEMENT specs for pp x tp: blocks' leading dim on 'stage' AND the
+    Megatron column/row dims on 'model' (tp.py's rules, applied under the
+    stage-stacked (S, layers, ...) layout). Used only for device_put — the
+    shard_map in_specs stay stage-only because 'model' runs as a GSPMD
+    *auto* axis inside the manual pipeline program."""
+    from jax.tree_util import keystr, tree_map_with_path
+
+    from tpu_dist.parallel.mesh import MODEL_AXIS
+    from tpu_dist.parallel.tp import _RULES
+
+    def spec(path, leaf):
+        k = keystr(path)
+        if "'blocks'" not in k:
+            # embed_head stays replicated over 'model' by design: the
+            # pipeline program computes embedding/head on every stage
+            return P()
+        base = [stage_axis] + [None] * (leaf.ndim - 1)
+        if leaf.ndim == 4:  # stacked (S, layers, in, out) KERNELS only
+            for key, rule in _RULES:
+                if f"'{key}'" in k and len(rule) == 2:
+                    # map tp.py's canonical 2-dim kernel rule onto the last
+                    # two dims of the stage-stacked leaf — ONE rule table
+                    base[-2] = model_axis if rule[0] == MODEL_AXIS else None
+                    base[-1] = model_axis if rule[1] == MODEL_AXIS else None
+                    break
+        return P(*base)
+
+    return tree_map_with_path(spec, state)
+
+
+def shard_state_pp(mesh: Mesh, state, stage_axis: str = STAGE_AXIS,
+                   model_axis: str = "model"):
     """Place a pipeline-layout TrainState: blocks (+ their optimizer state)
-    sharded over 'stage', everything else replicated."""
+    sharded over 'stage', everything else replicated. When the mesh also
+    carries a >1 'model' axis, block weights additionally shard
+    Megatron-style over it (pp x tp composition)."""
+    use_tp = model_axis in mesh.axis_names and mesh.shape[model_axis] > 1
+    specs = (pp_tp_placement_specs(state, stage_axis, model_axis) if use_tp
+             else pp_state_specs(state, stage_axis))
     return jax.tree.map(
         lambda leaf, s: jax.device_put(leaf, NamedSharding(mesh, s)),
-        state, pp_state_specs(state))
+        state, specs)
+
+
+def _pp_shard_map(mesh: Mesh, per_device, in_specs, out_specs,
+                  data_axis: str, stage_axis: str):
+    """shard_map with 'data'/'stage' MANUAL and — when the mesh carries a
+    >1 'model' axis — 'model' left as a GSPMD *auto* axis: the pipeline
+    schedule stays hand-written while XLA partitions each stage's block
+    math Megatron-style over 'model' (pp x tp composition; round-2 gap)."""
+    kwargs = {}
+    if "model" in mesh.axis_names and mesh.shape["model"] > 1:
+        kwargs["axis_names"] = frozenset({data_axis, stage_axis})
+    return shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False, **kwargs)
 
 
 def _stage_apply_builder(model):
@@ -240,12 +291,12 @@ def make_lm_pp_train_step(model, tx, mesh: Mesh, num_microbatches: int,
 
     def call(state, inputs, targets, rng):
         # specs are structural, so the caller's state pytree defines them
-        specs = pp_state_specs(state)
-        sharded = shard_map(
-            per_device, mesh=mesh,
-            in_specs=(specs, P(data_axis, None), P(data_axis, None), P()),
-            out_specs=(specs, P()),
-            check_vma=False)
+        # (manual axes only — a 'model' mesh axis rides as GSPMD auto)
+        specs = pp_state_specs(state, stage_axis)
+        sharded = _pp_shard_map(
+            mesh, per_device,
+            (specs, P(data_axis, None), P(data_axis, None), P()),
+            (specs, P()), data_axis, stage_axis)
         return sharded(state, inputs, targets, rng)
 
     return jax.jit(call, donate_argnums=(0,) if donate else ())
@@ -415,11 +466,10 @@ def make_lm_pp_1f1b_train_step(model, tx, mesh: Mesh, num_microbatches: int,
 
     def call(state, inputs, targets, rng):
         specs = pp_state_specs(state, stage_axis)
-        sharded = shard_map(
-            per_device, mesh=mesh,
-            in_specs=(specs, P(data_axis, None), P(data_axis, None), P()),
-            out_specs=(specs, P()),
-            check_vma=False)
+        sharded = _pp_shard_map(
+            mesh, per_device,
+            (specs, P(data_axis, None), P(data_axis, None), P()),
+            (specs, P()), data_axis, stage_axis)
         return sharded(state, inputs, targets, rng)
 
     return jax.jit(call, donate_argnums=(0,) if donate else ())
@@ -450,12 +500,11 @@ def make_lm_pp_eval_step(model, mesh: Mesh, num_microbatches: int,
 
     def call(params, inputs, targets, valid):
         p_specs = pp_state_specs(params, stage_axis)
-        sharded = shard_map(
-            per_device, mesh=mesh,
-            in_specs=(p_specs, P(data_axis, None), P(data_axis, None),
-                      P(data_axis)),
-            out_specs=P(),
-            check_vma=False)
+        sharded = _pp_shard_map(
+            mesh, per_device,
+            (p_specs, P(data_axis, None), P(data_axis, None),
+             P(data_axis)),
+            P(), data_axis, stage_axis)
         return sharded(params, inputs, targets, valid)
 
     return jax.jit(call)
