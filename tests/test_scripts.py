@@ -117,3 +117,19 @@ def test_script_evaluate_flag(tmp_path):
     out = run_script(tmp_path, "5.2.mnist.py",
                      TINY + ck(tmp_path) + ["--evaluate"])
     assert "best_acc1" in out
+
+
+def test_tool_lm_convergence(tmp_path):
+    out = run_script(tmp_path, "../tools/lm_convergence.py",
+                     ["--synth-tokens", "60000", "--batch-size", "16",
+                      "--seq-len", "128", "--d-model", "64", "--threshold",
+                      "20", "--max-epochs", "4", "--vocab-size", "128"])
+    assert "steps_to_ppl_20" in out
+
+
+def test_tool_data_rate(tmp_path):
+    out = run_script(tmp_path, "../tools/data_rate.py",
+                     ["--images", "32", "--size", "64", "--batch", "16",
+                      "--seconds", "0.5",
+                      "--root", os.path.join(str(tmp_path), "ifolder")])
+    assert "host_data_path_images_per_sec" in out

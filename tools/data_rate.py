@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Host data-path throughput: how fast can each dataset flavor feed batches?
+
+Measures ``get_batch`` images/sec for (a) the in-memory ArrayDataset gather
+(native C++ row memcpy when built) and (b) the lazy ImageFolder JPEG-decode
+path, against the device step rate the host must keep up with (BASELINE.md:
+~2,031 img/s/chip for ResNet-50 @ 224px). The VERDICT r2 note was that the
+ImageFolder decode rate was never measured — this makes it a one-command
+number. A synthetic ImageFolder tree (PIL-written JPEGs) is generated under
+--root when absent, so the tool runs in the zero-egress environment.
+
+Usage:
+    python tools/data_rate.py                 # both flavors, batch 256
+    python tools/data_rate.py --images 512 --batch 128 --workers 16
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _make_synthetic_imagefolder(root: str, n_images: int, size: int) -> str:
+    """root/train/<class>/<img>.jpg with random pixels; returns split dir."""
+    import numpy as np
+
+    try:
+        from PIL import Image
+    except ImportError:
+        raise SystemExit("PIL unavailable — cannot build the JPEG tree")
+    split = os.path.join(root, "train")
+    rng = np.random.default_rng(0)
+    for c in range(4):
+        cdir = os.path.join(split, f"class{c}")
+        os.makedirs(cdir, exist_ok=True)
+        for i in range(n_images // 4):
+            p = os.path.join(cdir, f"img{i}.jpg")
+            if not os.path.exists(p):
+                arr = rng.integers(0, 255, (size, size, 3), np.uint8)
+                Image.fromarray(arr).save(p, quality=85)
+    return split
+
+
+def _rate(ds, batch: int, seconds: float = 3.0) -> float:
+    import numpy as np
+
+    n = len(ds.labels) if hasattr(ds, "labels") else len(ds)
+    rng = np.random.default_rng(1)
+    # warm (page cache, thread pool spin-up)
+    ds.get_batch(rng.integers(0, n, batch))
+    done = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        ds.get_batch(rng.integers(0, n, batch))
+        done += batch
+    return done / (time.perf_counter() - t0)
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default="/tmp/tpu_dist_synth_imagefolder")
+    ap.add_argument("--images", type=int, default=256)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=3.0)
+    args = ap.parse_args()
+
+    from tpu_dist.data.datasets import _synthetic
+    from tpu_dist.data.imagefolder import ImageFolderDataset
+
+    arr = _synthetic(args.images, (args.size, args.size, 3), 4,
+                     proto_seed=0, sample_seed=1, name="synth-224")
+    arr_rate = _rate(arr, args.batch, args.seconds)
+    print(f"ArrayDataset gather ({args.size}px): {arr_rate:,.0f} img/s",
+          file=sys.stderr)
+
+    split = _make_synthetic_imagefolder(args.root, args.images, args.size)
+    folder = ImageFolderDataset(split, size=args.size, workers=args.workers)
+    dec_rate = _rate(folder, args.batch, args.seconds)
+    print(f"ImageFolder JPEG decode ({args.workers} workers): "
+          f"{dec_rate:,.0f} img/s", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "host_data_path_images_per_sec",
+        "array_gather": round(arr_rate, 1),
+        "imagefolder_decode": round(dec_rate, 1),
+        "batch": args.batch, "image_size": args.size,
+        "workers": args.workers,
+        "device_rate_note": "ResNet-50 @224px device rate ~2031 img/s/chip "
+                            "(BASELINE.md); decode below that means the host "
+                            "input pipeline is the binding constraint",
+    }))
+
+
+if __name__ == "__main__":
+    main()

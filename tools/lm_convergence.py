@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Steps/seconds to a val-perplexity threshold (the LM convergence north star).
+
+The LM twin of tools/convergence.py: trains over the synthetic affine corpus
+with the SAME LMTrainer the cookbook script uses and reports the first
+optimizer step count (and wall seconds) at which held-out perplexity drops
+to --threshold. The affine stream (x -> 5x+7 mod V, 5% noise) has an
+entropy floor of ~0.05*ln(V) + H(0.05) nats/token, so ppl approaches ~2 for
+V=512 when the rule is fully learned — a threshold of 4 proves real
+learning in any parallelism mode.
+
+Usage:
+    python tools/lm_convergence.py                        # dp
+    python tools/lm_convergence.py --mesh data=2,seq=4    # any scripts/8 mesh
+    python tools/lm_convergence.py --attn flash --precision bf16
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mesh", default=None,
+                    help="e.g. data=2,seq=4 (scripts/8 syntax)")
+    ap.add_argument("--precision", default="fp32", choices=["fp32", "bf16"])
+    ap.add_argument("--attn", default="full",
+                    choices=["full", "blockwise", "flash"])
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=256)
+    ap.add_argument("--vocab-size", type=int, default=512)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--num-heads", type=int, default=4)
+    ap.add_argument("--synth-tokens", type=int, default=500_000)
+    ap.add_argument("--lr", type=float, default=3e-2)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--threshold", type=float, default=4.0)
+    ap.add_argument("--max-epochs", type=int, default=10)
+    ap.add_argument("--steps-per-dispatch", type=int, default=8)
+    ap.add_argument("--pp-microbatches", type=int, default=4)
+    ap.add_argument("--pp-schedule", default="gpipe",
+                    choices=["gpipe", "1f1b"])
+    args = ap.parse_args()
+
+    from tpu_dist.parallel import launch
+    launch.initialize()
+
+    import jax
+
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    mesh_shape = mesh_axes = None
+    if args.mesh:
+        parts = [p.split("=") for p in args.mesh.split(",")]
+        mesh_shape = tuple(int(n) for _, n in parts)
+        mesh_axes = tuple(name.strip() for name, _ in parts)
+    shard_mode = bool(mesh_axes) and any(
+        a in ("seq", "stage") for a in mesh_axes)
+    cfg = LMConfig(
+        batch_size=args.batch_size, seq_len=args.seq_len,
+        vocab_size=args.vocab_size, d_model=args.d_model,
+        num_layers=args.num_layers, num_heads=args.num_heads,
+        synth_tokens=args.synth_tokens, lr=args.lr, seed=args.seed,
+        precision=args.precision, attn=args.attn,
+        epochs=args.max_epochs, print_freq=10 ** 9,
+        steps_per_dispatch=1 if shard_mode else args.steps_per_dispatch,
+        mesh_shape=mesh_shape,
+        mesh_axes=mesh_axes or ("data",),
+        pp_microbatches=args.pp_microbatches,
+        pp_schedule=args.pp_schedule,
+        checkpoint_dir=os.path.join("/tmp", "lm_convergence_ck"))
+    tr = LMTrainer(cfg)
+
+    t0 = time.time()
+    result = None
+    for epoch in range(cfg.epochs):
+        tr.train_epoch(epoch)
+        steps = int(jax.device_get(tr.state.step))
+        _, ppl, acc = tr.validate(epoch)
+        if jax.process_index() == 0:
+            print(f"epoch {epoch}: step {steps} val_ppl {ppl:.2f} "
+                  f"acc {acc:.3f}", file=sys.stderr, flush=True)
+        if ppl <= args.threshold:
+            result = {"steps_to_threshold": steps,
+                      "seconds_to_threshold": round(time.time() - t0, 2),
+                      "epochs": epoch + 1, "val_ppl": round(float(ppl), 3)}
+            break
+    if jax.process_index() == 0:
+        out = {"metric": f"steps_to_ppl_{args.threshold:g}",
+               "mode": tr.mode, "attn": args.attn,
+               "precision": args.precision,
+               "batch_size": args.batch_size, "seq_len": args.seq_len,
+               "seed": args.seed,
+               **(result or {"steps_to_threshold": None,
+                             "note": f"not reached in {cfg.epochs} epochs"})}
+        print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
