@@ -58,14 +58,22 @@ def test_lm_modes_agree_over_epoch(tmp_path):
     p_sp, _ = _params_vec(sp)
     pp = _run(LMConfig(mesh_shape=(4, 2), mesh_axes=("data", "stage"),
                        pp_microbatches=2, **TINY))
-    p_pp, _ = _params_vec(pp, unstack_pp=True)
+    _, pp_params = _params_vec(pp, unstack_pp=True)
     np.testing.assert_allclose(p_tp, p_dp, rtol=2e-4, atol=2e-6)
     np.testing.assert_allclose(p_sp, p_dp, rtol=2e-4, atol=2e-6)
-    # pp's stacked tree flattens in a different leaf order; compare the
-    # sorted-leaf concatenation only when shapes allow, else loss-level
-    assert p_pp.shape == p_dp.shape
-    np.testing.assert_allclose(np.sort(np.abs(p_pp)), np.sort(np.abs(p_dp)),
-                               rtol=2e-4, atol=2e-6)
+    # pp's stacked tree flattens in a different leaf order: compare per-path
+    # against dp (ADVICE r3: sorted magnitudes would also pass on permuted
+    # or sign-flipped leaves)
+    _, dp_params = _params_vec(dp)
+    flat_dp = {jax.tree_util.keystr(p): v for p, v in
+               jax.tree_util.tree_leaves_with_path(dp_params)}
+    flat_pp = {jax.tree_util.keystr(p): v for p, v in
+               jax.tree_util.tree_leaves_with_path(pp_params)}
+    assert flat_dp.keys() == flat_pp.keys()
+    for path in flat_dp:
+        np.testing.assert_allclose(
+            np.asarray(flat_pp[path]), np.asarray(flat_dp[path]),
+            rtol=2e-4, atol=2e-6, err_msg=path)
 
 
 def test_lm_mid_epoch_resume_step_exact(tmp_path):
@@ -94,6 +102,42 @@ def test_lm_mid_epoch_resume_step_exact(tmp_path):
     tr_res = LMTrainer(LMConfig(**{**kw, "checkpoint_dir":
                                    str(tmp_path / "res"), "resume": ck}))
     assert tr_res._skip_batches == 4  # 2 windows x K=2
+    tr_res.fit()
+    p_res, _ = _params_vec(tr_res)
+    np.testing.assert_allclose(p_full, p_res, rtol=1e-5, atol=1e-7)
+
+
+def test_lm_lr_schedule_survives_resume(tmp_path):
+    """Warmup+cosine LR trajectory continues exactly across a --resume
+    boundary (VERDICT r3 #2): interrupt mid-schedule, resume, and the final
+    params must match an uninterrupted run — which can only happen if every
+    post-resume update applied the same LR as the unbroken trajectory."""
+    kw = dict(steps_per_dispatch=2, lr_schedule="cosine", warmup_steps=3,
+              lr_decay_steps=12, checkpoint_dir=str(tmp_path / "full"),
+              **TINY)
+    tr_full = _run(LMConfig(**kw))
+    # the schedule is genuinely non-constant over the run (not vacuous)
+    lrs = [float(np.asarray(tr_full.lr_schedule(s))) for s in range(8)]
+    assert lrs[0] < lrs[2] <= lrs[3] > lrs[7]
+    p_full, _ = _params_vec(tr_full)
+
+    tr_int = LMTrainer(LMConfig(**{**kw, "checkpoint_dir":
+                                   str(tmp_path / "int")}))
+    real = tr_int.window_step
+    calls = {"n": 0}
+
+    def limited(*a, **k):
+        if calls["n"] == 2:
+            raise KeyboardInterrupt
+        calls["n"] += 1
+        return real(*a, **k)
+
+    tr_int.window_step = limited
+    with pytest.raises(KeyboardInterrupt):
+        tr_int.fit()
+    ck = os.path.join(str(tmp_path / "int"), "lm-checkpoint.msgpack")
+    tr_res = LMTrainer(LMConfig(**{**kw, "checkpoint_dir":
+                                   str(tmp_path / "res"), "resume": ck}))
     tr_res.fit()
     p_res, _ = _params_vec(tr_res)
     np.testing.assert_allclose(p_full, p_res, rtol=1e-5, atol=1e-7)

@@ -5,7 +5,41 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpu_dist.ops.optim import make_optimizer, step_decay_schedule
+from tpu_dist.ops.optim import (lm_lr_schedule, make_optimizer,
+                                step_decay_schedule)
+
+
+def test_lm_schedule_warmup_then_constant():
+    sched = lm_lr_schedule(0.1, "constant", warmup_steps=4)
+    # linear ramp: steps 0..3 apply 0.025, 0.05, 0.075, 0.1; then flat
+    np.testing.assert_allclose([float(sched(s)) for s in range(6)],
+                               [0.025, 0.05, 0.075, 0.1, 0.1, 0.1],
+                               rtol=1e-6)
+
+
+def test_lm_schedule_cosine_endpoints_and_floor():
+    sched = lm_lr_schedule(0.2, "cosine", warmup_steps=10, total_steps=110,
+                           min_frac=0.1)
+    assert float(sched(10)) == pytest.approx(0.2)          # post-warmup peak
+    assert float(sched(60)) == pytest.approx(0.2 * 0.55)   # halfway point
+    assert float(sched(110)) == pytest.approx(0.02)        # floor reached
+    assert float(sched(500)) == pytest.approx(0.02)        # flat after
+    assert lm_lr_schedule(0.2, "cosine", warmup_steps=0,
+                          total_steps=100)(100) == pytest.approx(0.0)
+
+
+def test_lm_schedule_step_matches_reference_rule():
+    sched = lm_lr_schedule(0.1, "step", steps_per_epoch=10, step_epochs=30)
+    ref = step_decay_schedule(0.1, steps_per_epoch=10, step_epochs=30)
+    for s in (0, 10 * 29, 10 * 30, 10 * 60):
+        assert float(sched(s)) == pytest.approx(float(ref(s)))
+
+
+def test_lm_schedule_rejects_bad_kind_and_horizon():
+    with pytest.raises(ValueError, match="unknown lr schedule"):
+        lm_lr_schedule(0.1, "linear")
+    with pytest.raises(ValueError, match="cosine needs"):
+        lm_lr_schedule(0.1, "cosine", warmup_steps=10, total_steps=10)
 
 
 def test_step_decay_matches_reference_rule():

@@ -40,6 +40,11 @@ def main():
     ap.add_argument("--num-heads", type=int, default=4)
     ap.add_argument("--synth-tokens", type=int, default=500_000)
     ap.add_argument("--lr", type=float, default=3e-2)
+    ap.add_argument("--lr-schedule", default="constant",
+                    choices=["constant", "cosine", "step"])
+    ap.add_argument("--warmup-steps", type=int, default=0)
+    ap.add_argument("--lr-decay-steps", type=int, default=0)
+    ap.add_argument("--lr-min-frac", type=float, default=0.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--threshold", type=float, default=4.0)
     ap.add_argument("--max-epochs", type=int, default=10)
@@ -69,6 +74,8 @@ def main():
         vocab_size=args.vocab_size, d_model=args.d_model,
         num_layers=args.num_layers, num_heads=args.num_heads,
         synth_tokens=args.synth_tokens, lr=args.lr, seed=args.seed,
+        lr_schedule=args.lr_schedule, warmup_steps=args.warmup_steps,
+        lr_decay_steps=args.lr_decay_steps, lr_min_frac=args.lr_min_frac,
         precision=args.precision, attn=args.attn,
         epochs=args.max_epochs, print_freq=10 ** 9,
         steps_per_dispatch=1 if shard_mode else args.steps_per_dispatch,
@@ -96,6 +103,8 @@ def main():
     if jax.process_index() == 0:
         out = {"metric": f"steps_to_ppl_{args.threshold:g}",
                "mode": tr.mode, "attn": args.attn,
+               "lr_schedule": args.lr_schedule,
+               "warmup_steps": args.warmup_steps,
                "precision": args.precision,
                "batch_size": args.batch_size, "seq_len": args.seq_len,
                "seed": args.seed,

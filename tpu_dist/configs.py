@@ -133,6 +133,16 @@ class LMConfig:
     lr: float = 3e-2
     momentum: float = 0.9
     weight_decay: float = 0.0
+    lr_schedule: str = "constant"  # constant | cosine | step, each with
+                                   # linear warmup (ops.optim.lm_lr_schedule;
+                                   # resume-safe — the step count rides in
+                                   # the checkpointed optimizer state)
+    warmup_steps: int = 0          # linear warmup updates before the decay
+    lr_decay_steps: int = 0        # cosine horizon in optimizer steps
+                                   # (0 = max_steps if set, else
+                                   # epochs * steps_per_epoch)
+    lr_min_frac: float = 0.0       # cosine floor as a fraction of base lr
+    lr_step_epochs: int = 30       # 'step' decay interval (reference C19)
 
     # -- distribution (mesh axes pick the parallelism: data / model / seq /
     #    expert / stage — see scripts/8)

