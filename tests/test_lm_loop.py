@@ -76,6 +76,29 @@ def test_lm_modes_agree_over_epoch(tmp_path):
             rtol=2e-4, atol=2e-6, err_msg=path)
 
 
+@pytest.mark.parametrize("mesh_kw", [
+    dict(mesh_shape=(2, 4), mesh_axes=("data", "seq")),
+    dict(mesh_shape=(4, 2), mesh_axes=("data", "stage"), pp_microbatches=2),
+    dict(mesh_shape=(4, 2), mesh_axes=("data", "stage"), pp_microbatches=2,
+         pp_schedule="1f1b"),
+])
+def test_lm_shard_mode_windowed_matches_per_batch(mesh_kw):
+    """VERDICT r3 #3: sp and pp get the K-steps-per-dispatch HBM-resident
+    window path (lax.scan over index windows INSIDE the shard_map program);
+    it must equal the per-batch host-fed path parameter for parameter, and
+    its one-dispatch eval must reproduce the per-batch perplexity."""
+    tr1 = _run(LMConfig(data_placement="host", **mesh_kw, **TINY))
+    tr4 = _run(LMConfig(steps_per_dispatch=4, **mesh_kw, **TINY))
+    assert tr1.device_data is False and tr4.device_data is True
+    assert (int(jax.device_get(tr1.state.step))
+            == int(jax.device_get(tr4.state.step)) > 0)
+    unstack = "stage" in mesh_kw["mesh_axes"]
+    p1, _ = _params_vec(tr1, unstack_pp=unstack)
+    p4, _ = _params_vec(tr4, unstack_pp=unstack)
+    np.testing.assert_allclose(p1, p4, rtol=1e-5, atol=1e-7)
+    assert tr4.best_ppl == pytest.approx(tr1.best_ppl, rel=1e-4)
+
+
 def test_lm_mid_epoch_resume_step_exact(tmp_path):
     """Interrupt between windows, resume -> same params as uninterrupted."""
     kw = dict(steps_per_dispatch=2, checkpoint_dir=str(tmp_path / "full"),

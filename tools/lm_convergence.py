@@ -67,8 +67,6 @@ def main():
         parts = [p.split("=") for p in args.mesh.split(",")]
         mesh_shape = tuple(int(n) for _, n in parts)
         mesh_axes = tuple(name.strip() for name, _ in parts)
-    shard_mode = bool(mesh_axes) and any(
-        a in ("seq", "stage") for a in mesh_axes)
     cfg = LMConfig(
         batch_size=args.batch_size, seq_len=args.seq_len,
         vocab_size=args.vocab_size, d_model=args.d_model,
@@ -78,7 +76,7 @@ def main():
         lr_decay_steps=args.lr_decay_steps, lr_min_frac=args.lr_min_frac,
         precision=args.precision, attn=args.attn,
         epochs=args.max_epochs, print_freq=10 ** 9,
-        steps_per_dispatch=1 if shard_mode else args.steps_per_dispatch,
+        steps_per_dispatch=args.steps_per_dispatch,
         mesh_shape=mesh_shape,
         mesh_axes=mesh_axes or ("data",),
         pp_microbatches=args.pp_microbatches,

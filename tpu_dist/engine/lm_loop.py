@@ -129,33 +129,48 @@ class LMTrainer:
         self.k = cfg.steps_per_dispatch
         if self.k < 1:
             raise ValueError("steps_per_dispatch must be >= 1")
-        shard_modes = self.use_sp or self.use_pp
-        if self.k > 1 and shard_modes:
-            raise ValueError("steps_per_dispatch > 1 supports the jit modes "
-                             "(dp/fsdp/tp/ep); sp and pp are shard_map steps")
         if cfg.data_placement not in ("auto", "host", "device"):
             raise ValueError(f"unknown data_placement {cfg.data_placement!r}")
-        if cfg.data_placement == "device" and shard_modes:
-            raise ValueError("data_placement='device' supports the jit modes")
         rows_bytes = (len(self.train_ds) + len(self.val_ds)) * \
             (cfg.seq_len + 1) * 4
         fits = rows_bytes <= int(os.environ.get("TPU_DIST_DEVICE_DATA_MAX",
                                                 str(1 << 30)))
         self.device_data = (cfg.data_placement == "device" or
                             (cfg.data_placement == "auto" and fits
-                             and self.k > 1 and not shard_modes))
+                             and self.k > 1))
         self._train_rows_dev = None
         self._val_rows_dev = None
         self._prefetched_windows = None
         if self.device_data:
             self._train_rows_dev = jax.device_put(
                 self.train_ds.rows_array(), replicated(self.mesh))
-            self.window_step = make_lm_indexed_multi_train_step(
-                self.model, self.tx, self.mesh)
             self._val_rows_dev = jax.device_put(
                 self.val_ds.rows_array(), replicated(self.mesh))
-            self.window_eval_step = make_lm_indexed_eval_step(
-                self.model, self.mesh)
+            # every mode gets the K-steps-per-dispatch window path: the jit
+            # modes via the GSPMD step, sp/pp via a lax.scan over index
+            # windows INSIDE their shard_map programs (VERDICT r3 #3)
+            if self.use_pp:
+                from tpu_dist.parallel.pp import (
+                    make_lm_pp_indexed_eval_step,
+                    make_lm_pp_indexed_multi_train_step)
+                self.window_step = make_lm_pp_indexed_multi_train_step(
+                    self.model, self.tx, self.mesh, cfg.pp_microbatches,
+                    schedule=cfg.pp_schedule)
+                self.window_eval_step = make_lm_pp_indexed_eval_step(
+                    self.model, self.mesh, cfg.pp_microbatches)
+            elif self.use_sp:
+                from tpu_dist.engine.lm_steps import (
+                    make_lm_sp_indexed_eval_step,
+                    make_lm_sp_indexed_multi_train_step)
+                self.window_step = make_lm_sp_indexed_multi_train_step(
+                    self._sp_ctor, self.tx, self.mesh)
+                self.window_eval_step = make_lm_sp_indexed_eval_step(
+                    self._sp_ctor, self.mesh)
+            else:
+                self.window_step = make_lm_indexed_multi_train_step(
+                    self.model, self.tx, self.mesh)
+                self.window_eval_step = make_lm_indexed_eval_step(
+                    self.model, self.mesh)
         elif self.k > 1:
             raise ValueError(
                 "steps_per_dispatch > 1 needs the device-resident row path "
@@ -296,6 +311,7 @@ class LMTrainer:
             ctor = partial(tiny_lm, **{k: v for k, v in
                                        self._model_ctor_kw.items()
                                        if k != "attn_fn"})
+            self._sp_ctor = ctor  # the windowed sp steps rebind it per-axis
             self.train_step = make_lm_sp_train_step(ctor, self.tx, self.mesh)
             self.eval_step = make_lm_sp_eval_step(ctor, self.mesh)
             self.data_spec = P("data", "seq")

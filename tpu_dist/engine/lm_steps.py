@@ -38,6 +38,16 @@ from tpu_dist.engine.steps import _apply_update
 from tpu_dist.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
 
+LM_METRIC_KEYS = ("loss_sum", "correct1", "count")
+
+
+def zeros_lm_metrics():
+    """Additive identity for lm_loss_and_metrics sums — THE definition of
+    the metric-key set (every eval/accumulator path builds from it, so a
+    new metric key cannot silently desynchronize a tree.map)."""
+    return {k: jnp.float32(0.0) for k in LM_METRIC_KEYS}
+
+
 def lm_loss_and_metrics(logits, targets, mask):
     """Per-token CE sums. logits (B,L,V) fp32; targets (B,L); mask (B,L)."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
@@ -205,9 +215,7 @@ def make_lm_indexed_eval_step(model, mesh: Mesh,
             _, m = lm_loss_and_metrics(logits, targets, mask)
             return jax.tree.map(jnp.add, sums, m), None
 
-        zeros = {k: jnp.float32(0.0)
-                 for k in ("loss_sum", "correct1", "count")}
-        sums, _ = jax.lax.scan(body, zeros, (idx, valid))
+        sums, _ = jax.lax.scan(body, zeros_lm_metrics(), (idx, valid))
         return sums
 
     return jax.jit(step, in_shardings=(None, repl, idx_sh, idx_sh),
@@ -245,23 +253,13 @@ def make_lm_sp_eval_step(model_ctor: Callable, mesh: Mesh,
     return jax.jit(sharded)
 
 
-def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,
-                          data_axis: str = DATA_AXIS,
-                          seq_axis: str = SEQ_AXIS,
-                          aux_weight: float = 0.01,
-                          donate: bool = True) -> Callable:
-    """shard_map step: batch on 'data', sequence on 'seq', ring attention.
+def _lm_sp_step_fn(model, tx, aux_weight: float, data_axis: str,
+                   seq_axis: str) -> Callable:
+    """THE per-device sp train step shared by the single-batch and
+    indexed-window wrappers (the sp twin of _lm_step_fn): runs INSIDE
+    shard_map on a (data, seq) mesh with (B/data, L/seq) token shards."""
 
-    ``model_ctor(attn_fn)`` builds the model with the given attention fn so
-    the ring can be bound per-axis (tpu_dist.models.transformer.tiny_lm or a
-    partial of TransformerLM).
-    """
-    from tpu_dist.parallel.ring_attention import ring_attention_fn
-
-    model = model_ctor(attn_fn=ring_attention_fn(seq_axis))
-    n_seq = mesh.shape[seq_axis]
-
-    def per_device(state: TrainState, inputs, targets, rng):
+    def step(state: TrainState, inputs, targets, rng):
         seq_idx = jax.lax.axis_index(seq_axis)
         dp_idx = jax.lax.axis_index(data_axis)
         dropout_rng = jax.random.fold_in(
@@ -289,9 +287,125 @@ def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,
             lambda m: jax.lax.psum(jax.lax.psum(m, seq_axis), data_axis), metrics)
         return _apply_update(tx, state, grads, stats, metrics)
 
+    return step
+
+
+def _sp_window_slices(rows, seq_idx, shard_len):
+    """Device-side shift+shard: from replicated (B_local, L+1) token rows,
+    this seq shard's (inputs, targets) — the same slices the host-side
+    make_lm_batches + (data, seq) sharding would deliver (a shard's targets
+    include the first token of the next shard, so no boundary masking)."""
+    start = seq_idx * shard_len
+    inputs = jax.lax.dynamic_slice_in_dim(rows, start, shard_len, axis=1)
+    targets = jax.lax.dynamic_slice_in_dim(rows, start + 1, shard_len, axis=1)
+    return inputs, targets
+
+
+def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,
+                          data_axis: str = DATA_AXIS,
+                          seq_axis: str = SEQ_AXIS,
+                          aux_weight: float = 0.01,
+                          donate: bool = True) -> Callable:
+    """shard_map step: batch on 'data', sequence on 'seq', ring attention.
+
+    ``model_ctor(attn_fn)`` builds the model with the given attention fn so
+    the ring can be bound per-axis (tpu_dist.models.transformer.tiny_lm or a
+    partial of TransformerLM).
+    """
+    from tpu_dist.parallel.ring_attention import ring_attention_fn
+
+    model = model_ctor(attn_fn=ring_attention_fn(seq_axis))
+    per_device = _lm_sp_step_fn(model, tx, aux_weight, data_axis, seq_axis)
+
     sharded = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis), P()),
         out_specs=(P(), P()),
         check_vma=False)
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_lm_sp_indexed_multi_train_step(model_ctor: Callable, tx, mesh: Mesh,
+                                        data_axis: str = DATA_AXIS,
+                                        seq_axis: str = SEQ_AXIS,
+                                        aux_weight: float = 0.01,
+                                        donate: bool = True) -> Callable:
+    """K sp optimizer steps per dispatch from HBM-resident rows (VERDICT r3
+    #3 — the long-context mode was locked out of dispatch amortization,
+    paying a host round-trip plus full token upload per step on exactly the
+    workloads with the biggest per-step payload).
+
+    signature: (state, rows_all (N, L+1) i32 REPLICATED, idx (K, B) i32
+    sharded (None, data), rng) -> (state, metric sums over K steps).
+
+    The lax.scan over index windows runs INSIDE the existing shard_map
+    program: each iteration gathers its (B/data, L+1) rows at HBM bandwidth
+    and takes this device's sequence shard with a device-side shift —
+    identical math to K sequential make_lm_sp_train_step calls (same
+    per-step rng fold; parameter equality asserted to rtol 1e-5 in
+    tests/test_lm_loop.py)."""
+    from tpu_dist.parallel.ring_attention import ring_attention_fn
+
+    model = model_ctor(attn_fn=ring_attention_fn(seq_axis))
+    n_seq = mesh.shape[seq_axis]
+    one_step = _lm_sp_step_fn(model, tx, aux_weight, data_axis, seq_axis)
+
+    def per_device(state: TrainState, rows_all, idx, rng):
+        shard_len = (rows_all.shape[1] - 1) // n_seq
+        seq_idx = jax.lax.axis_index(seq_axis)
+
+        def body(st, idx_b):
+            rows = jnp.take(rows_all, idx_b, axis=0)
+            inputs, targets = _sp_window_slices(rows, seq_idx, shard_len)
+            return one_step(st, inputs, targets, rng)
+
+        state, metrics_k = jax.lax.scan(body, state, idx)
+        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
+
+    sharded = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P(), P(None, data_axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_lm_sp_indexed_eval_step(model_ctor: Callable, mesh: Mesh,
+                                 data_axis: str = DATA_AXIS,
+                                 seq_axis: str = SEQ_AXIS) -> Callable:
+    """Whole-val-set perplexity in ONE dispatch under sequence parallelism:
+    (params, rows_all (N, L+1) REPLICATED, idx (K, B) sharded (None, data),
+    valid (K, B) f32 same sharding) -> metric sums over all K batches,
+    sampler wrap-padding masked per row, psum'd over both axes."""
+    from tpu_dist.parallel.ring_attention import ring_attention_fn
+
+    model = model_ctor(attn_fn=ring_attention_fn(seq_axis))
+    n_seq = mesh.shape[seq_axis]
+
+    def per_device(params, rows_all, idx, valid):
+        shard_len = (rows_all.shape[1] - 1) // n_seq
+        seq_idx = jax.lax.axis_index(seq_axis)
+        pos_offset = seq_idx * shard_len
+
+        def body(sums, blk):
+            idx_b, valid_b = blk
+            rows = jnp.take(rows_all, idx_b, axis=0)
+            inputs, targets = _sp_window_slices(rows, seq_idx, shard_len)
+            logits = model.apply({"params": params}, inputs, train=False,
+                                 pos_offset=pos_offset)
+            mask = jnp.broadcast_to(valid_b[:, None], targets.shape).astype(
+                jnp.float32)
+            _, m = lm_loss_and_metrics(logits, targets, mask)
+            return jax.tree.map(jnp.add, sums, m), None
+
+        sums, _ = jax.lax.scan(body, zeros_lm_metrics(), (idx, valid))
+        return jax.tree.map(
+            lambda m: jax.lax.psum(jax.lax.psum(m, seq_axis), data_axis),
+            sums)
+
+    sharded = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P(), P(None, data_axis), P(None, data_axis)),
+        out_specs=P(),
+        check_vma=False)
+    return jax.jit(sharded)

@@ -195,11 +195,9 @@ def _stage_apply_builder(model):
     return apply_stage, ln_f, model.dtype
 
 
-_ZERO_METRICS = {"loss_sum": 0.0, "correct1": 0.0, "count": 0.0}
-
-
 def _zeros_metrics():
-    return {k: jnp.float32(v) for k, v in _ZERO_METRICS.items()}
+    from tpu_dist.engine.lm_steps import zeros_lm_metrics
+    return zeros_lm_metrics()
 
 
 def _pp_forward_builder(model, mesh: Mesh, num_microbatches: int,
@@ -306,6 +304,26 @@ def make_lm_pp_train_step(model, tx, mesh: Mesh, num_microbatches: int,
     ``model`` is the TransformerLM whose geometry the params came from (its
     Block/embedding hyperparameters are reused functionally here).
     """
+    per_device = _pp_gpipe_step_builder(model, tx, mesh, num_microbatches,
+                                        data_axis, stage_axis)
+
+    def call(state, inputs, targets, rng):
+        # specs are structural, so the caller's state pytree defines them
+        # (manual axes only — a 'model' mesh axis rides as GSPMD auto)
+        specs = pp_state_specs(state, stage_axis)
+        sharded = _pp_shard_map(
+            mesh, per_device,
+            (specs, P(data_axis, None), P(data_axis, None), P()),
+            (specs, P()), data_axis, stage_axis)
+        return sharded(state, inputs, targets, rng)
+
+    return jax.jit(call, donate_argnums=(0,) if donate else ())
+
+
+def _pp_gpipe_step_builder(model, tx, mesh: Mesh, num_microbatches: int,
+                           data_axis: str, stage_axis: str) -> Callable:
+    """Per-device GPipe train step (runs INSIDE shard_map), shared by the
+    single-batch and indexed-window wrappers."""
     fwd_loss = _pp_forward_builder(model, mesh, num_microbatches, stage_axis)
 
     def per_device(state: TrainState, inputs, targets, rng):
@@ -334,17 +352,7 @@ def make_lm_pp_train_step(model, tx, mesh: Mesh, num_microbatches: int,
             metrics)
         return _apply_update(tx, state, grads, stats, metrics)
 
-    def call(state, inputs, targets, rng):
-        # specs are structural, so the caller's state pytree defines them
-        # (manual axes only — a 'model' mesh axis rides as GSPMD auto)
-        specs = pp_state_specs(state, stage_axis)
-        sharded = _pp_shard_map(
-            mesh, per_device,
-            (specs, P(data_axis, None), P(data_axis, None), P()),
-            (specs, P()), data_axis, stage_axis)
-        return sharded(state, inputs, targets, rng)
-
-    return jax.jit(call, donate_argnums=(0,) if donate else ())
+    return per_device
 
 
 def make_lm_pp_1f1b_train_step(model, tx, mesh: Mesh, num_microbatches: int,
@@ -371,6 +379,24 @@ def make_lm_pp_1f1b_train_step(model, tx, mesh: Mesh, num_microbatches: int,
     mean; block grads stay stage-local, embed/head grads psum over 'stage',
     everything pmeans over 'data'.
     """
+    per_device = _pp_1f1b_step_builder(model, tx, mesh, num_microbatches,
+                                       data_axis, stage_axis)
+
+    def call(state, inputs, targets, rng):
+        specs = pp_state_specs(state, stage_axis)
+        sharded = _pp_shard_map(
+            mesh, per_device,
+            (specs, P(data_axis, None), P(data_axis, None), P()),
+            (specs, P()), data_axis, stage_axis)
+        return sharded(state, inputs, targets, rng)
+
+    return jax.jit(call, donate_argnums=(0,) if donate else ())
+
+
+def _pp_1f1b_step_builder(model, tx, mesh: Mesh, num_microbatches: int,
+                          data_axis: str, stage_axis: str) -> Callable:
+    """Per-device 1F1B train step (runs INSIDE shard_map), shared by the
+    single-batch and indexed-window wrappers."""
     from tpu_dist.engine.lm_steps import lm_loss_and_metrics
 
     S = mesh.shape[stage_axis]
@@ -564,15 +590,81 @@ def make_lm_pp_1f1b_train_step(model, tx, mesh: Mesh, num_microbatches: int,
             metrics)
         return _apply_update(tx, state, grads, {}, metrics)
 
-    def call(state, inputs, targets, rng):
+    return per_device
+
+
+def make_lm_pp_indexed_multi_train_step(model, tx, mesh: Mesh,
+                                        num_microbatches: int,
+                                        schedule: str = "gpipe",
+                                        data_axis: str = DATA_AXIS,
+                                        stage_axis: str = STAGE_AXIS,
+                                        donate: bool = True) -> Callable:
+    """K pipeline optimizer steps per dispatch from HBM-resident rows
+    (VERDICT r3 #3): a lax.scan over (K, B) index windows INSIDE the
+    shard_map program, so pipeline runs amortize the host round-trip the
+    same way the jit modes do.
+
+    signature: (state, rows_all (N, L+1) i32 REPLICATED, idx (K, B) i32
+    sharded (None, data), rng) -> (state, metric sums over K steps).
+    Identical math to K sequential per-batch pp steps (parameter equality
+    asserted to rtol 1e-5 in tests/test_lm_loop.py)."""
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pp schedule {schedule!r} (gpipe|1f1b)")
+    builder = (_pp_1f1b_step_builder if schedule == "1f1b"
+               else _pp_gpipe_step_builder)
+    one_step = builder(model, tx, mesh, num_microbatches, data_axis,
+                       stage_axis)
+
+    def per_device(state: TrainState, rows_all, idx, rng):
+        def body(st, idx_b):
+            rows = jnp.take(rows_all, idx_b, axis=0)   # (B_local, L+1)
+            return one_step(st, rows[:, :-1], rows[:, 1:], rng)
+
+        state, metrics_k = jax.lax.scan(body, state, idx)
+        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
+
+    def call(state, rows_all, idx, rng):
         specs = pp_state_specs(state, stage_axis)
         sharded = _pp_shard_map(
             mesh, per_device,
-            (specs, P(data_axis, None), P(data_axis, None), P()),
+            (specs, P(), P(None, data_axis), P()),
             (specs, P()), data_axis, stage_axis)
-        return sharded(state, inputs, targets, rng)
+        return sharded(state, rows_all, idx, rng)
 
     return jax.jit(call, donate_argnums=(0,) if donate else ())
+
+
+def make_lm_pp_indexed_eval_step(model, mesh: Mesh, num_microbatches: int,
+                                 data_axis: str = DATA_AXIS,
+                                 stage_axis: str = STAGE_AXIS) -> Callable:
+    """Whole-val-set perplexity in ONE dispatch through the pipeline:
+    (params, rows_all (N, L+1) REPLICATED, idx (K, B) sharded (None, data),
+    valid (K, B) f32 same sharding) -> metric sums over all K batches,
+    real on the last stage only, psum'd over 'stage' and 'data'."""
+    fwd_loss = _pp_forward_builder(model, mesh, num_microbatches, stage_axis)
+
+    def per_device(params, rows_all, idx, valid):
+        def body(sums, blk):
+            idx_b, valid_b = blk
+            rows = jnp.take(rows_all, idx_b, axis=0)
+            _, m = fwd_loss(params, rows[:, :-1], rows[:, 1:],
+                            valid_b.astype(jnp.float32))
+            return jax.tree.map(jnp.add, sums, m), None
+
+        sums, _ = jax.lax.scan(body, _zeros_metrics(), (idx, valid))
+        return jax.tree.map(
+            lambda v: jax.lax.psum(jax.lax.psum(v, stage_axis), data_axis),
+            sums)
+
+    def call(params, rows_all, idx, valid):
+        p_specs = pp_state_specs(params, stage_axis)
+        sharded = _pp_shard_map(
+            mesh, per_device,
+            (p_specs, P(), P(None, data_axis), P(None, data_axis)),
+            P(), data_axis, stage_axis)
+        return sharded(params, rows_all, idx, valid)
+
+    return jax.jit(call)
 
 
 def make_lm_pp_eval_step(model, mesh: Mesh, num_microbatches: int,
