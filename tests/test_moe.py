@@ -229,6 +229,123 @@ def test_ep_actually_shards_expert_compute():
     assert w.addressable_shards[0].data.shape[0] == 1  # 8 experts / 8 devs
 
 
+def test_moe_remat_matches_no_remat(moe_setup):
+    """--remat with MoE (VERDICT r3 #4): per-block rematerialization must
+    change memory, never math — identical loss/metrics and updated params,
+    with the sown aux-loss/router-mass intermediates surviving nn.remat."""
+    _, _, tx, inputs, targets = moe_setup
+    mesh = make_mesh((8,), ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    di, dt = jax.device_put(inputs, sh), jax.device_put(targets, sh)
+
+    def one_step(remat):
+        model = MoETransformerLM(vocab_size=V, max_len=L, num_experts=E,
+                                 num_layers=4, remat=remat)
+        params = model.init({"params": jax.random.PRNGKey(0)},
+                            jnp.zeros((1, L), jnp.int32),
+                            train=False)["params"]
+        st = jax.device_put(TrainState.create(params, {}, tx),
+                            replicated(mesh))
+        step = make_lm_train_step(model, tx, mesh, donate=False)
+        lowered = step.lower(st, di, dt, jax.random.PRNGKey(1)).compile()
+        st, m = step(st, di, dt, jax.random.PRNGKey(1))
+        return (jax.device_get(st.params), jax.device_get(m),
+                int(lowered.memory_analysis().temp_size_in_bytes))
+
+    p_plain, m_plain, mem_plain = one_step(False)
+    p_remat, m_remat, mem_remat = one_step(True)
+    for k in ("loss_sum", "correct1", "count", "router_mass_sum"):
+        assert float(m_remat[k]) == pytest.approx(float(m_plain[k]),
+                                                  rel=1e-5), k
+    assert float(m_remat["router_mass_n"]) > 0  # sow survives nn.remat
+    flat_a = {jax.tree_util.keystr(p): v for p, v in
+              jax.tree_util.tree_leaves_with_path(p_plain)}
+    flat_b = {jax.tree_util.keystr(p): v for p, v in
+              jax.tree_util.tree_leaves_with_path(p_remat)}
+    for path in flat_a:
+        np.testing.assert_allclose(np.asarray(flat_b[path]),
+                                   np.asarray(flat_a[path]),
+                                   rtol=1e-5, atol=1e-7, err_msg=path)
+    # and remat actually buys activation memory at depth
+    assert mem_remat < mem_plain, (mem_remat, mem_plain)
+
+
+def test_moe_tp_composition_matches_dp(moe_setup):
+    """MoE x TP (VERDICT r3 #4): a (data=2, expert=2, model=2) mesh with
+    expert weights Megatron-split over 'model' on top of their 'expert'
+    shard must reproduce the replicated-DP step."""
+    from tpu_dist.parallel.ep import shard_state_ep
+
+    model, params, tx, inputs, targets = moe_setup
+    mesh_dp = make_mesh((8,), ("data",))
+    st = jax.device_put(TrainState.create(params, {}, tx),
+                        replicated(mesh_dp))
+    step = make_lm_train_step(model, tx, mesh_dp, donate=False)
+    sh = NamedSharding(mesh_dp, P("data"))
+    st_dp, m_dp = step(st, jax.device_put(inputs, sh),
+                       jax.device_put(targets, sh), jax.random.PRNGKey(1))
+
+    mesh = make_mesh((2, 2, 2), ("data", "expert", "model"))
+    st_tp = shard_state_ep(mesh, TrainState.create(params, {}, tx))
+    w_in = st_tp.params["block0"]["moe"]["w_in"]
+    assert w_in.sharding.spec == P("expert", None, "model")
+    local = w_in.addressable_shards[0].data.shape
+    assert local[0] == w_in.shape[0] // 2 and local[2] == w_in.shape[2] // 2
+    qkv = st_tp.params["block0"]["qkv"]["kernel"]
+    assert qkv.sharding.spec == P(None, "model")
+    step_tp = make_lm_train_step(model, tx, mesh, donate=False)
+    sh_tp = NamedSharding(mesh, P("data"))
+    st_tp, m_tp = step_tp(st_tp, jax.device_put(inputs, sh_tp),
+                          jax.device_put(targets, sh_tp),
+                          jax.random.PRNGKey(1))
+
+    for k in ("loss_sum", "correct1", "count"):
+        assert float(jax.device_get(m_tp[k])) == pytest.approx(
+            float(jax.device_get(m_dp[k])), rel=1e-4), k
+    flat_dp = {jax.tree_util.keystr(p): v for p, v in
+               jax.tree_util.tree_leaves_with_path(jax.device_get(st_dp.params))}
+    flat_tp = {jax.tree_util.keystr(p): v for p, v in
+               jax.tree_util.tree_leaves_with_path(jax.device_get(st_tp.params))}
+    for path in flat_dp:
+        np.testing.assert_allclose(np.asarray(flat_tp[path]),
+                                   np.asarray(flat_dp[path]),
+                                   rtol=2e-4, atol=2e-6, err_msg=path)
+
+
+def test_moe_analytical_flops_accounting():
+    """The MoE MFU formula (VERDICT r3 #4): counts top_k-activated expert
+    params (not all E) plus the dispatch/combine einsum term, and feeds a
+    real (non-None) TFLOP/s figure through LMTrainer._mfu."""
+    from tpu_dist.utils.mfu import lm_flops_per_token, moe_lm_flops_per_token
+
+    model = MoETransformerLM(vocab_size=V, max_len=L, num_experts=E)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, L), jnp.int32), train=False)["params"]
+    kw = dict(num_layers=2, seq_len=L, d_model=64, num_experts=E,
+              total_tokens=B * L)
+    f1 = moe_lm_flops_per_token(params, router_top_k=1, **kw)
+    f2 = moe_lm_flops_per_token(params, router_top_k=2, **kw)
+    assert f2 > f1  # top-2 activates twice the expert params
+    # dense formula over the same params counts ALL experts -> overstates
+    dense_all = lm_flops_per_token(params, 2, L, 64)
+    expert_sz = sum(int(np.prod(v.shape)) for p, v in
+                    jax.tree_util.tree_leaves_with_path(params)
+                    if "w_in" in jax.tree_util.keystr(p)
+                    or "w_out" in jax.tree_util.keystr(p))
+    assert f1 < dense_all + 12 * E * 64 * 64 * 2  # loose sanity ceiling
+    assert f1 > 6.0 * expert_sz / E              # at least one expert's MLP
+
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+    cfg = LMConfig(batch_size=8, seq_len=32, d_model=32, num_layers=1,
+                   num_heads=2, vocab_size=64, synth_tokens=2000,
+                   num_experts=4, print_freq=100, epochs=1, max_steps=2)
+    tr = LMTrainer(cfg)
+    tr.train_epoch(0)
+    tflops, _ = tr._mfu(1000.0)
+    assert tflops is not None and tflops > 0
+
+
 def test_moe_training_reports_router_mass(tmp_path):
     """The dropped-token diagnostic reaches the training surface: a dp-moe
     LMTrainer epoch's meters carry RMass (mean combine mass per token)."""

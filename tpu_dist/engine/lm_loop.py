@@ -34,7 +34,8 @@ from tpu_dist.configs import LMConfig
 from tpu_dist.data import DistributedSampler, assemble_global
 from tpu_dist.data.tokens import load_token_dataset
 from tpu_dist.engine import checkpoint as ckpt
-from tpu_dist.engine.lm_steps import (make_lm_batches, make_lm_eval_step,
+from tpu_dist.engine.lm_steps import (LM_METRIC_KEYS, make_lm_batches,
+                                      make_lm_eval_step,
                                       make_lm_indexed_eval_step,
                                       make_lm_indexed_multi_train_step,
                                       make_lm_sp_eval_step,
@@ -77,7 +78,8 @@ class LMTrainer:
                      + ("+tp" if self.use_pp and self.use_tp else "")
                      if self.use_pp else
                      "sp-ring" if self.use_sp else
-                     "ep-moe" if self.use_ep else
+                     ("ep-moe" + ("+tp" if self.use_tp else ""))
+                     if self.use_ep else
                      "tp" if self.use_tp else
                      "fsdp" if cfg.fsdp else
                      ("dp-moe" if cfg.num_experts else "dp"))
@@ -236,10 +238,12 @@ class LMTrainer:
         cfg = self.cfg
         multi = [a for a in ("seq", "model", "expert", "stage")
                  if a in self.mesh.axis_names and self.mesh.shape[a] > 1]
-        if len(multi) > 1 and set(multi) != {"stage", "model"}:
+        if len(multi) > 1 and set(multi) not in ({"stage", "model"},
+                                                 {"expert", "model"}):
             raise ValueError(
                 f"unsupported model-parallel axis combination {multi} "
-                "(one axis at a time, or stage+model for pp x tp)")
+                "(one axis at a time, stage+model for pp x tp, or "
+                "expert+model for MoE x tp)")
         if self.use_pp and (cfg.num_experts or cfg.fsdp):
             raise ValueError("a 'stage' mesh axis composes only with 'data' "
                              "(GPipe over dense TransformerLM blocks)")
@@ -247,11 +251,9 @@ class LMTrainer:
             raise ValueError("an 'expert' mesh axis requires num_experts > 0")
         if self.use_sp and cfg.num_experts:
             raise ValueError("MoE + sequence parallelism not supported yet")
-        if self.use_tp and cfg.num_experts:
-            raise ValueError("MoE + tensor parallelism not supported: use "
-                             "data=N,expert=M instead")
-        if cfg.num_experts and cfg.remat:
-            raise ValueError("remat supports the dense TransformerLM only")
+        if self.use_tp and cfg.num_experts and not self.use_ep:
+            raise ValueError("MoE + pure tensor parallelism not supported: "
+                             "use data=N,expert=M[,model=K]")
         if cfg.fsdp and (self.use_sp or self.use_tp or self.use_ep):
             self.log("warning: fsdp applies to the pure data-parallel "
                      "layout; ignored with a seq/model/expert mesh axis")
@@ -280,9 +282,8 @@ class LMTrainer:
                      attn_fn=attn_fn, remat=cfg.remat)
         if cfg.num_experts:
             from tpu_dist.models.moe import MoETransformerLM
-            moe_kw = {k: v for k, v in lm_kw.items() if k != "remat"}
             model = MoETransformerLM(num_experts=cfg.num_experts,
-                                     router_top_k=cfg.router_top_k, **moe_kw)
+                                     router_top_k=cfg.router_top_k, **lm_kw)
         else:
             from tpu_dist.models.transformer import tiny_lm
             model = tiny_lm(**lm_kw)
@@ -536,7 +537,7 @@ class LMTrainer:
                 self.state.params, self._val_rows_dev,
                 assemble_global(win_sh, np.ascontiguousarray(idx)),
                 assemble_global(win_sh, np.ascontiguousarray(valid))))
-            sums = {k: float(m[k]) for k in ("loss_sum", "correct1", "count")}
+            sums = {k: float(m[k]) for k in LM_METRIC_KEYS}
         else:
             sh = NamedSharding(self.mesh, self.data_spec)
             vsh = NamedSharding(self.mesh, self.valid_spec)
@@ -549,7 +550,7 @@ class LMTrainer:
                     assemble_global(sh, np.ascontiguousarray(inputs)),
                     assemble_global(sh, np.ascontiguousarray(targets)),
                     assemble_global(vsh, np.ascontiguousarray(valid[i]))))
-            sums = {"loss_sum": 0.0, "correct1": 0.0, "count": 0.0}
+            sums = {k: 0.0 for k in LM_METRIC_KEYS}
             for m in jax.device_get(pending):
                 for k in sums:
                     sums[k] += float(m[k])
@@ -562,44 +563,30 @@ class LMTrainer:
 
     # ------------------------------------------------------------------
     def _mfu(self, tok_per_sec: float):
-        """(tflops, mfu). Dense LMs use the ANALYTICAL model-FLOPs formula
-        (6*N_non-embed + 6*layers*L*d, fwd+bwd, causal) — XLA's cost model
-        counts scan bodies once and cannot cost Pallas custom calls, so it
-        understates flash runs. MoE falls back to the XLA cost model."""
-        from tpu_dist.utils.mfu import (lm_flops_per_token, peak_tflops_for,
-                                        step_flops)
+        """(tflops, mfu). ANALYTICAL model-FLOPs accounting for dense
+        (6*N_non-embed + 6*layers*L*d, fwd+bwd, causal) AND MoE (dense part
+        + top_k-activated expert params + the GShard dispatch/combine
+        einsums) — XLA's cost model counts scan bodies once and cannot cost
+        Pallas custom calls, so it understates flash runs, and it cannot
+        see how many experts a token activates (VERDICT r3 #4)."""
+        from tpu_dist.utils.mfu import (lm_flops_per_token,
+                                        moe_lm_flops_per_token,
+                                        peak_tflops_for)
         cfg = self.cfg
-        if self._flops_per_step is None and not cfg.num_experts:
-            per_token = lm_flops_per_token(
-                self.state.params, cfg.num_layers, cfg.seq_len, cfg.d_model)
+        if self._flops_per_step is None:
+            if cfg.num_experts:
+                per_token = moe_lm_flops_per_token(
+                    self.state.params, cfg.num_layers, cfg.seq_len,
+                    cfg.d_model, cfg.num_experts, cfg.router_top_k,
+                    total_tokens=cfg.batch_size * cfg.seq_len)
+            else:
+                per_token = lm_flops_per_token(
+                    self.state.params, cfg.num_layers, cfg.seq_len,
+                    cfg.d_model)
             ndev = self.mesh.devices.size
-            # stored per-device-program per-step, like the XLA path below
+            # stored as the per-device-program share of one step's FLOPs
             self._flops_per_step = per_token * cfg.batch_size * \
                 cfg.seq_len / ndev
-        if self._flops_per_step is None:
-            idx, _ = self._epoch_indices(self.train_ds, True, 0)
-            if self.device_data:
-                # SAME (K, B) window shape as training, so the lowering hits
-                # the already-compiled executable instead of building a
-                # second K=1 variant. XLA's cost model counts a lax.scan
-                # body ONCE regardless of trip count (verified; bench.py
-                # documents the same), so this IS the per-step figure.
-                k = min(self.k, len(idx))
-                win_sh = NamedSharding(self.mesh, P(None, "data"))
-                args = (self.state, self._train_rows_dev,
-                        assemble_global(win_sh, np.ascontiguousarray(
-                            idx[:k])), self.rng)
-                f = step_flops(self.window_step, *args)
-            else:
-                sh = NamedSharding(self.mesh, self.data_spec)
-                rows = self.train_ds.get_rows(idx[0])
-                inputs, targets = make_lm_batches(rows)
-                f = step_flops(
-                    self.train_step, self.state,
-                    assemble_global(sh, np.ascontiguousarray(inputs)),
-                    assemble_global(sh, np.ascontiguousarray(targets)),
-                    self.rng)
-            self._flops_per_step = f or 0.0
         if not self._flops_per_step:
             return None, None
         # per-device program FLOPs over the tokens IT processes per step

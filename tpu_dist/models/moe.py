@@ -22,6 +22,19 @@ import jax
 import jax.numpy as jnp
 
 
+def moe_group_geometry(total_tokens: int, seq_len: int, num_experts: int,
+                       router_top_k: int, group_size: int = 512,
+                       capacity_factor: float = 1.25):
+    """(group tokens S, per-expert capacity C) — THE dispatch geometry,
+    shared by MoEMLP and the analytical MFU accounting
+    (tpu_dist.utils.mfu.moe_lm_flops_per_token) so they cannot drift."""
+    s = min(group_size, total_tokens)
+    if total_tokens % s:  # group size must divide tokens; fall back to rows
+        s = seq_len
+    cap = max(1, int(s / num_experts * capacity_factor * router_top_k))
+    return s, cap
+
+
 class MoEMLP(nn.Module):
     """MoE feed-forward: top-1 (Switch) or top-2 (GShard) gate,
     capacity-bounded dispatch.
@@ -56,11 +69,9 @@ class MoEMLP(nn.Module):
         t = b * l
         e = self.num_experts
         f = self.mlp_ratio * d
-        s = min(self.group_size, t)
-        if t % s:  # group size must divide tokens; fall back to batch rows
-            s = l
+        s, cap = moe_group_geometry(t, l, e, self.router_top_k,
+                                    self.group_size, self.capacity_factor)
         g = t // s
-        cap = max(1, int(s / e * self.capacity_factor * self.router_top_k))
 
         tokens = x.reshape(g, s, d)
         gate_logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
@@ -170,6 +181,10 @@ class MoETransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attn_fn: Callable = None
     router_top_k: int = 1
+    remat: bool = False  # rematerialize each MoE block in the backward pass
+                         # (the expert dispatch/combine tensors are the
+                         # memory hogs — jax.checkpoint per block is the
+                         # same HBM lever the dense LM has)
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, pos_offset=0):
@@ -178,10 +193,12 @@ class MoETransformerLM(nn.Module):
         pos = pos_offset + jnp.arange(tokens.shape[1])
         x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
                          name="pos_emb")(pos)[None]
+        block_cls = (nn.remat(MoEBlock, static_argnums=(2,)) if self.remat
+                     else MoEBlock)
         for i in range(self.num_layers):
-            x = MoEBlock(self.num_heads, self.num_experts, self.dtype,
-                         self.attn_fn, self.router_top_k,
-                         name=f"block{i}")(x, train=train)
+            x = block_cls(self.num_heads, self.num_experts, self.dtype,
+                          self.attn_fn, self.router_top_k,
+                          name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                           name="lm_head")(x)
