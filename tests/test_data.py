@@ -59,6 +59,54 @@ def test_prefetch_to_device_preserves_order():
         assert int(np.asarray(imgs)[0, 0]) == i
 
 
+def test_stream_prefetch_passes_none_and_exception_items():
+    """Tagged control envelopes (ADVICE r3): a producer may legitimately
+    yield None or exception INSTANCES as items — neither truncates the
+    stream nor raises — while a raising producer still propagates."""
+    from tpu_dist.data.loader import stream_prefetch
+
+    items = [1, None, ValueError("payload, not control"), 4]
+    out = list(stream_prefetch(iter(items)))
+    assert out[0] == 1 and out[1] is None and out[3] == 4
+    assert isinstance(out[2], ValueError)
+
+    def boom():
+        yield 1
+        raise RuntimeError("assembly failed")
+
+    got = []
+    try:
+        for x in stream_prefetch(boom()):
+            got.append(x)
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised and got == [1]
+
+
+def test_token_bin_size_alignment_checked(tmp_path):
+    """A .bin whose byte size is not a whole number of tokens for the
+    configured dtype fails loudly instead of yielding garbage ids."""
+    import os
+
+    import pytest
+
+    from tpu_dist.data.tokens import _load_stream
+
+    p = tmp_path / "odd.bin"
+    p.write_bytes(b"\x01\x02\x03")  # 3 bytes: not divisible by uint16
+    with pytest.raises(ValueError, match="whole number"):
+        _load_stream(str(p))
+    os.environ["TPU_DIST_TOKEN_DTYPE"] = "uint32"
+    try:
+        q = tmp_path / "ok16.bin"
+        q.write_bytes(np.arange(6, dtype=np.uint16).tobytes())  # 12 bytes
+        arr, _ = _load_stream(str(q))  # 4-aligned: loads as uint32
+        assert arr.dtype == np.uint32
+    finally:
+        del os.environ["TPU_DIST_TOKEN_DTYPE"]
+
+
 def test_loader_propagates_worker_errors():
     class Bad:
         def get_batch(self, idx):

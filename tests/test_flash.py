@@ -116,3 +116,12 @@ def test_lm_forward_same_logits(fn_name):
     out = lm_eff.apply({"params": params}, tokens, train=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_flash_block_k_alias_conflict_raises():
+    """recompute_block is a legacy alias for block_k: passing both is an
+    error, not a silent override (ADVICE r3)."""
+    with pytest.raises(ValueError, match="not both"):
+        flash_attention_fn(block_k=256, recompute_block=128)
+    # the alias alone still works
+    assert flash_attention_fn(recompute_block=128) is not None

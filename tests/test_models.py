@@ -55,7 +55,8 @@ def test_param_count_matches_torchvision(arch):
     assert ours == torch_params, f"{arch}: {ours} vs torchvision {torch_params}"
 
 
-@pytest.mark.parametrize("arch", ["vgg16", "vgg11", "densenet121"])
+@pytest.mark.parametrize("arch", ["vgg16", "vgg11", "densenet121",
+                                  "mobilenet_v2", "squeezenet1_1"])
 def test_cnn_zoo_forward_shape(arch):
     """Non-ResNet CNN plans (registry-breadth parity with the reference's
     any-torchvision-arch factory, 1.dataparallel.py:23-24): same input sizes
@@ -65,7 +66,23 @@ def test_cnn_zoo_forward_shape(arch):
     variables = m.init({"params": jax.random.PRNGKey(0)}, x, train=False)
     out = m.apply(variables, x, train=False)
     assert out.shape == (2, 10)
-    assert "batch_stats" in variables  # BN plans carry running stats
+    if arch != "squeezenet1_1":  # squeezenet's plan is BN-free upstream too
+        assert "batch_stats" in variables  # BN plans carry running stats
+
+
+@pytest.mark.parametrize("arch", ["mobilenet_v2", "squeezenet1_1"])
+def test_mobile_class_param_count_matches_torchvision(arch):
+    """The round-4 catalog additions map 1:1 onto torchvision's layer plans
+    (depthwise/inverted-residual and fire-module families) — exact
+    trainable-parameter equality like the resnet/densenet checks."""
+    torchvision = pytest.importorskip("torchvision")
+    tm = torchvision.models.__dict__[arch](num_classes=10)
+    torch_params = sum(p.numel() for p in tm.parameters())
+    m = create_model(arch, num_classes=10)
+    variables = m.init({"params": jax.random.PRNGKey(0)},
+                       jnp.zeros((1, 32, 32, 3)), train=False)
+    ours = _param_count(variables["params"])
+    assert ours == torch_params, f"{arch}: {ours} vs torchvision {torch_params}"
 
 
 def test_densenet121_feature_param_count_matches_torchvision():

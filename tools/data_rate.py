@@ -70,14 +70,26 @@ def main():
     ap.add_argument("--seconds", type=float, default=3.0)
     args = ap.parse_args()
 
+    from tpu_dist import _native
     from tpu_dist.data.datasets import _synthetic
     from tpu_dist.data.imagefolder import ImageFolderDataset
 
     arr = _synthetic(args.images, (args.size, args.size, 3), 4,
                      proto_seed=0, sample_seed=1, name="synth-224")
-    arr_rate = _rate(arr, args.batch, args.seconds)
-    print(f"ArrayDataset gather ({args.size}px): {arr_rate:,.0f} img/s",
-          file=sys.stderr)
+    # numpy fallback first (force the library off), then the native path —
+    # the VERDICT r3 #5 comparison that pins where assembly time goes
+    with _native.numpy_fallback():
+        numpy_rate = _rate(arr, args.batch, args.seconds)
+    print(f"ArrayDataset gather, numpy fallback ({args.size}px): "
+          f"{numpy_rate:,.0f} img/s", file=sys.stderr)
+    arr_rate = None
+    if _native.available():
+        arr_rate = _rate(arr, args.batch, args.seconds)
+        print(f"ArrayDataset gather, native csrc ({args.size}px): "
+              f"{arr_rate:,.0f} img/s", file=sys.stderr)
+    else:
+        print("native gather library unavailable (no toolchain?)",
+              file=sys.stderr)
 
     split = _make_synthetic_imagefolder(args.root, args.images, args.size)
     folder = ImageFolderDataset(split, size=args.size, workers=args.workers)
@@ -87,7 +99,9 @@ def main():
 
     print(json.dumps({
         "metric": "host_data_path_images_per_sec",
-        "array_gather": round(arr_rate, 1),
+        "array_gather_native": (round(arr_rate, 1)
+                                if arr_rate is not None else None),
+        "array_gather_numpy": round(numpy_rate, 1),
         "imagefolder_decode": round(dec_rate, 1),
         "batch": args.batch, "image_size": args.size,
         "workers": args.workers,

@@ -59,6 +59,23 @@ def available() -> bool:
     return _load() is not None
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def numpy_fallback():
+    """Force the pure-numpy path inside the block (benchmark/debug hook —
+    tools/data_rate.py compares the two implementations with it), however
+    the lazy-load cache is organized internally."""
+    global _lib, _tried
+    saved = (_lib, _tried)
+    _lib, _tried = None, True
+    try:
+        yield
+    finally:
+        _lib, _tried = saved
+
+
 def gather_batch(images: np.ndarray, labels: np.ndarray,
                  indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """out = (images[indices], labels[indices]) via native memcpy rows.

@@ -49,46 +49,19 @@ class DataLoader:
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, ...]]:
         idx, valid = self.sampler.indices_with_valid()
-        nbatches = len(self)
-        q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
-        stop = threading.Event()
 
-        def _put(item) -> bool:
-            """Queue-put that aborts when the consumer is gone (never parks
-            forever on a full queue after the consumer abandoned iteration)."""
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.2)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+        def batches():
+            for b in range(len(self)):
+                sel = slice(b * self.batch_size, (b + 1) * self.batch_size)
+                batch = self.dataset.get_batch(idx[sel])
+                if self.emit_valid:
+                    batch = (*batch, valid[sel].astype(np.float32))
+                yield batch
 
-        def producer():
-            try:
-                for b in range(nbatches):
-                    sel = slice(b * self.batch_size, (b + 1) * self.batch_size)
-                    batch = self.dataset.get_batch(idx[sel])
-                    if self.emit_valid:
-                        batch = (*batch, valid[sel].astype(np.float32))
-                    if not _put(batch):
-                        return
-                _put(None)
-            except BaseException as e:  # surface worker errors on the consumer
-                _put(e)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is None:
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            stop.set()
+        # ONE queue pipeline for the whole data layer: stream_prefetch owns
+        # the producer thread, bounded staging, error propagation, and
+        # consumer-abandonment shutdown
+        yield from stream_prefetch(batches(), depth=self.queue_depth)
 
 
 def stream_prefetch(iterable, depth: int = 2):
@@ -99,9 +72,6 @@ def stream_prefetch(iterable, depth: int = 2):
     trainers' streamed host->device window paths (datasets too large for
     HBM residency); exceptions propagate to the consumer, and abandoning
     the generator stops the producer."""
-    import queue
-    import threading
-
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     stop = threading.Event()
 
@@ -114,24 +84,26 @@ def stream_prefetch(iterable, depth: int = 2):
                 continue
         return False
 
+    # control flows in tagged envelopes, so items that happen to be None or
+    # exception instances pass through untouched (ADVICE r3)
     def producer():
         try:
             for item in iterable:
-                if not _put(item):
+                if not _put(("item", item)):
                     return
-            _put(None)
+            _put(("done", None))
         except BaseException as e:  # surface assembly/upload errors
-            _put(e)
+            _put(("err", e))
 
     threading.Thread(target=producer, daemon=True).start()
     try:
         while True:
-            item = q.get()
-            if item is None:
+            tag, payload = q.get()
+            if tag == "done":
                 return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+            if tag == "err":
+                raise payload
+            yield payload
     finally:
         stop.set()
 

@@ -103,12 +103,20 @@ def synthetic_stream(n_tokens: int, vocab_size: int, seed: int = 0,
 
 def _load_stream(path: str) -> Tuple[np.ndarray, int]:
     """(stream, inferred_vocab) from a token file. ``.npy`` loads through
-    numpy; ``.bin`` memmaps as uint16 (uint32 if sized 4-aligned and
-    TPU_DIST_TOKEN_DTYPE=uint32)."""
+    numpy; ``.bin`` memmaps with the dtype named by TPU_DIST_TOKEN_DTYPE
+    (default uint16, nanoGPT's format), after checking the file size is a
+    whole number of items — a wrong dtype setting on a uint16 file would
+    otherwise yield garbage token ids (ADVICE r3)."""
     if path.endswith(".npy"):
         arr = np.load(path, mmap_mode="r")
     else:
         dtype = np.dtype(os.environ.get("TPU_DIST_TOKEN_DTYPE", "uint16"))
+        size = os.path.getsize(path)
+        if size % dtype.itemsize:
+            raise ValueError(
+                f"{path}: {size} bytes is not a whole number of "
+                f"{dtype.name} tokens — set TPU_DIST_TOKEN_DTYPE to the "
+                "dtype the file was written with")
         arr = np.memmap(path, dtype=dtype, mode="r")
     # FULL scan for the max id (chunked — sequential memmap reads run at
     # disk bandwidth): a sampled max would under-size the embedding table

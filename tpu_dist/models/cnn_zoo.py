@@ -1,9 +1,10 @@
-"""Non-ResNet CNN plans: VGG and DenseNet (reference component C2 breadth).
+"""Non-ResNet CNN plans: VGG, DenseNet, MobileNetV2, SqueezeNet (C2 breadth).
 
 The reference's factory accepts ANY lowercase torchvision callable by name
 (reference 1.dataparallel.py:23-24), so its catalog includes families beyond
-ResNet.  These two prove the registry generalizes past one family — the
-torchvision layer plans (vgg16 with BatchNorm, densenet121) rebuilt
+ResNet.  These families prove the registry generalizes — the torchvision
+layer plans (vgg16 with BatchNorm, densenet121, mobilenet_v2's inverted
+residuals with depthwise convs, squeezenet1_1's fire modules) rebuilt
 TPU-first in the same idiom as tpu_dist.models.resnet:
 
 * NHWC layout, flax.linen, configurable compute dtype with fp32 norm
@@ -116,6 +117,124 @@ class DenseNet(nn.Module):
         x = nn.relu(norm(name="bn_final")(x))
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+class _InvertedResidual(nn.Module):
+    """MobileNetV2 block: 1x1 expand -> 3x3 depthwise -> 1x1 project,
+    residual when stride 1 and channels match. ReLU6 activations, linear
+    bottleneck (no activation after the projection)."""
+
+    out_ch: int
+    stride: int
+    expand: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        in_ch = x.shape[-1]
+        h = x
+        if self.expand != 1:
+            h = nn.Conv(in_ch * self.expand, (1, 1), use_bias=False,
+                        dtype=self.dtype, name="expand")(h)
+            h = jnp.clip(norm(name="bn_expand")(h), 0.0, 6.0)
+        ch = h.shape[-1]
+        h = nn.Conv(ch, (3, 3), (self.stride, self.stride),
+                    padding=[(1, 1), (1, 1)], feature_group_count=ch,
+                    use_bias=False, dtype=self.dtype, name="depthwise")(h)
+        h = jnp.clip(norm(name="bn_dw")(h), 0.0, 6.0)
+        h = nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="project")(h)
+        h = norm(name="bn_project")(h)
+        if self.stride == 1 and in_ch == self.out_ch:
+            h = x + h
+        return h
+
+
+class MobileNetV2(nn.Module):
+    """torchvision mobilenet_v2 plan: stem 32/s2, seven inverted-residual
+    stages (t, c, n, s), 1280-wide head conv, global pool + classifier."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+    # (expand t, channels c, repeats n, first-stride s) — torchvision's table
+    plan: Sequence = ((1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+                      (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+                      (6, 320, 1, 1))
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), (2, 2), padding=[(1, 1), (1, 1)],
+                    use_bias=False, dtype=self.dtype, name="stem")(x)
+        x = jnp.clip(norm(name="bn_stem")(x), 0.0, 6.0)
+        for si, (t, c, n, s) in enumerate(self.plan):
+            for i in range(n):
+                x = _InvertedResidual(c, s if i == 0 else 1, t, self.dtype,
+                                      name=f"stage{si}_block{i}")(x, train)
+        x = nn.Conv(1280, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="head_conv")(x)
+        x = jnp.clip(norm(name="bn_head")(x), 0.0, 6.0)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.2, deterministic=not train, name="drop")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+class _Fire(nn.Module):
+    """SqueezeNet fire module: 1x1 squeeze, parallel 1x1 + 3x3 expands."""
+
+    squeeze: int
+    e1: int
+    e3: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        s = nn.relu(nn.Conv(self.squeeze, (1, 1), dtype=self.dtype,
+                            name="squeeze")(x))
+        a = nn.relu(nn.Conv(self.e1, (1, 1), dtype=self.dtype,
+                            name="expand1")(s))
+        b = nn.relu(nn.Conv(self.e3, (3, 3), padding=[(1, 1), (1, 1)],
+                            dtype=self.dtype, name="expand3")(s))
+        return jnp.concatenate([a, b], axis=-1)
+
+
+class SqueezeNet(nn.Module):
+    """torchvision squeezenet1_1 plan (fire modules, no BatchNorm, conv
+    classifier head with global average pooling)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # torchvision geometry: stem conv and pools are UNPADDED (at 224px
+        # the maps run 111 -> 55 -> 27 -> 13, identically here; ceil_mode
+        # and floor agree at every one of these sizes)
+        fire = partial(_Fire, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(64, (3, 3), (2, 2), padding="VALID",
+                            dtype=self.dtype, name="stem")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = fire(16, 64, 64, name="fire2")(x)
+        x = fire(16, 64, 64, name="fire3")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = fire(32, 128, 128, name="fire4")(x)
+        x = fire(32, 128, 128, name="fire5")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = fire(48, 192, 192, name="fire6")(x)
+        x = fire(48, 192, 192, name="fire7")(x)
+        x = fire(64, 256, 256, name="fire8")(x)
+        x = fire(64, 256, 256, name="fire9")(x)
+        x = nn.Dropout(0.5, deterministic=not train, name="drop")(x)
+        x = nn.relu(nn.Conv(self.num_classes, (1, 1), dtype=self.dtype,
+                            name="head_conv")(x))
+        x = jnp.mean(x, axis=(1, 2))
         return x.astype(jnp.float32)
 
 

@@ -31,6 +31,8 @@ _REGISTRY: Dict[str, Tuple[Callable, str]] = {
     "vgg11": (cnn_zoo.VGG11, "image"),
     "vgg16": (cnn_zoo.VGG16, "image"),
     "densenet121": (cnn_zoo.DenseNet121, "image"),
+    "mobilenet_v2": (cnn_zoo.MobileNetV2, "image"),
+    "squeezenet1_1": (cnn_zoo.SqueezeNet, "image"),
     "lenet": (lenet.LeNet, "image"),
     "mnist_net": (lenet.LeNet, "image"),  # reference 5.2 'Net' alias
     "vit_tiny": (vit.ViTTiny, "image"),

@@ -403,7 +403,7 @@ def _fa_backward(q, k, v, out, lse, g, causal, q_offset, kv_offset,
     return unfold(dq, lq), unfold(dk, lk), unfold(dv, lk)
 
 
-def flash_attention_fn(block_q: int = 512, block_k: int = 512,
+def flash_attention_fn(block_q: int = 512, block_k: int | None = None,
                        interpret: bool | None = None,
                        recompute_block: int | None = None):
     """Returns attn(q, k, v, causal=True, q_offset=0, kv_offset=0) backed by
@@ -412,11 +412,18 @@ def flash_attention_fn(block_q: int = 512, block_k: int = 512,
     blockwise forward).
 
     ``interpret=None`` auto-selects interpreter mode off-TPU so the same
-    code runs in the CPU test mesh. ``recompute_block`` is accepted as a
-    legacy alias for ``block_k`` (the round-2 kernel's recompute granularity).
+    code runs in the CPU test mesh. ``recompute_block`` is a legacy alias
+    for ``block_k`` (the round-2 kernel's recompute granularity); passing
+    both is an error rather than a silent override (ADVICE r3). ``block_k``
+    defaults to 512.
     """
     if recompute_block is not None:
+        if block_k is not None:
+            raise ValueError("pass block_k or its legacy alias "
+                             "recompute_block, not both")
         block_k = recompute_block
+    if block_k is None:
+        block_k = 512
 
     def pick_interpret():
         if interpret is not None:
