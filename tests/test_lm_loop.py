@@ -99,6 +99,26 @@ def test_lm_shard_mode_windowed_matches_per_batch(mesh_kw):
     assert tr4.best_ppl == pytest.approx(tr1.best_ppl, rel=1e-4)
 
 
+def test_lm_grad_accum_matches_full_batch():
+    """--grad-accum-steps N: N sequential microbatches averaging into ONE
+    update must equal the full-batch step (dropout-free model), and the
+    optimizer step count must be identical."""
+    kw = dict(data_placement="host", **{**TINY, "batch_size": 16})
+    tr1 = _run(LMConfig(**kw))
+    tr2 = _run(LMConfig(grad_accum_steps=2, **kw))
+    assert (int(jax.device_get(tr1.state.step))
+            == int(jax.device_get(tr2.state.step)) > 0)
+    p1, _ = _params_vec(tr1)
+    p2, _ = _params_vec(tr2)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-7)
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        LMTrainer(LMConfig(grad_accum_steps=2, steps_per_dispatch=2, **TINY))
+    with pytest.raises(ValueError, match="jit"):
+        LMTrainer(LMConfig(grad_accum_steps=2, mesh_shape=(2, 4),
+                           mesh_axes=("data", "seq"), **TINY))
+
+
 def test_lm_mid_epoch_resume_step_exact(tmp_path):
     """Interrupt between windows, resume -> same params as uninterrupted."""
     kw = dict(steps_per_dispatch=2, checkpoint_dir=str(tmp_path / "full"),

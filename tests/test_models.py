@@ -70,11 +70,23 @@ def test_cnn_zoo_forward_shape(arch):
         assert "batch_stats" in variables  # BN plans carry running stats
 
 
-@pytest.mark.parametrize("arch", ["mobilenet_v2", "squeezenet1_1"])
+@pytest.mark.parametrize("arch", ["resnext50_32x4d", "wide_resnet50_2"])
+def test_resnet_variant_forward_shape(arch):
+    """Grouped (ResNeXt) and widened (WideResNet) bottleneck plans."""
+    m = create_model(arch, num_classes=10)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = m.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    out = m.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+
+
+@pytest.mark.parametrize("arch", ["mobilenet_v2", "squeezenet1_1",
+                                  "resnext50_32x4d", "wide_resnet50_2"])
 def test_mobile_class_param_count_matches_torchvision(arch):
     """The round-4 catalog additions map 1:1 onto torchvision's layer plans
-    (depthwise/inverted-residual and fire-module families) — exact
-    trainable-parameter equality like the resnet/densenet checks."""
+    (depthwise/inverted-residual, fire-module, grouped- and widened-
+    bottleneck families) — exact trainable-parameter equality like the
+    resnet/densenet checks."""
     torchvision = pytest.importorskip("torchvision")
     tm = torchvision.models.__dict__[arch](num_classes=10)
     torch_params = sum(p.numel() for p in tm.parameters())

@@ -159,6 +159,8 @@ class LMConfig:
     # -- dispatch/data path (same TPU levers as TrainConfig)
     steps_per_dispatch: int = 1
     data_placement: str = "auto"   # auto | host | device (HBM-resident rows)
+    grad_accum_steps: int = 1      # microbatches per optimizer step (jit
+                                   # modes; global token batches beyond HBM)
 
     # -- loop control
     print_freq: int = 10

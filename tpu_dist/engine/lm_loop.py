@@ -133,6 +133,31 @@ class LMTrainer:
             raise ValueError("steps_per_dispatch must be >= 1")
         if cfg.data_placement not in ("auto", "host", "device"):
             raise ValueError(f"unknown data_placement {cfg.data_placement!r}")
+        # gradient accumulation (jit modes): N sequential microbatches per
+        # optimizer step — the same mutual exclusions as the image Trainer
+        self.accum = cfg.grad_accum_steps
+        if self.accum < 1:
+            raise ValueError("grad_accum_steps must be >= 1")
+        if self.accum > 1:
+            if self.use_sp or self.use_pp:
+                raise ValueError("grad_accum_steps > 1 supports the jit "
+                                 "modes (dp/fsdp/tp/ep); pp already "
+                                 "microbatches via --pp-microbatches")
+            if self.k > 1:
+                raise ValueError("grad_accum_steps and steps_per_dispatch "
+                                 "> 1 are mutually exclusive")
+            if cfg.data_placement == "device":
+                raise ValueError("grad_accum_steps > 1 requires "
+                                 "data_placement='host'/'auto' (the indexed "
+                                 "window step has no microbatch loop)")
+            if cfg.batch_size % (self.accum * d_size):
+                raise ValueError(
+                    f"global batch {cfg.batch_size} not divisible by "
+                    f"grad_accum_steps x data axis ({self.accum} x {d_size})")
+            from tpu_dist.engine.lm_steps import (
+                make_lm_grad_accum_train_step)
+            self.train_step = make_lm_grad_accum_train_step(
+                self.model, self.tx, self.mesh)
         rows_bytes = (len(self.train_ds) + len(self.val_ds)) * \
             (cfg.seq_len + 1) * 4
         fits = rows_bytes <= int(os.environ.get("TPU_DIST_DEVICE_DATA_MAX",
@@ -404,7 +429,14 @@ class LMTrainer:
                            prefix=f"Epoch: [{epoch}]")
         skip = self._skip_batches
         self._skip_batches = 0
-        sh = NamedSharding(self.mesh, self.data_spec)
+        if self.accum > 1:
+            # host-side split into (N, B/N, L) microbatches, sharded
+            # (None, 'data') so every microbatch spans all devices
+            sh = NamedSharding(self.mesh, P(None, "data"))
+            shape = lambda a: a.reshape(self.accum, -1, a.shape[-1])
+        else:
+            sh = NamedSharding(self.mesh, self.data_spec)
+            shape = lambda a: a
 
         def batches():
             # row gather + shift + upload dispatch, run in the prefetch
@@ -413,8 +445,10 @@ class LMTrainer:
                 rows = self.train_ds.get_rows(idx[j])
                 inputs, targets = make_lm_batches(rows)
                 yield (j,
-                       assemble_global(sh, np.ascontiguousarray(inputs)),
-                       assemble_global(sh, np.ascontiguousarray(targets)))
+                       assemble_global(sh, np.ascontiguousarray(
+                           shape(inputs))),
+                       assemble_global(sh, np.ascontiguousarray(
+                           shape(targets))))
 
         from tpu_dist.data.loader import stream_prefetch
         pending = []

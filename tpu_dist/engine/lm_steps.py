@@ -95,6 +95,29 @@ def make_lm_batches(tokens: np.ndarray):
     return tokens[:, :-1], tokens[:, 1:]
 
 
+def _lm_grads_and_metrics(model, aux_weight: float, params, inputs, targets,
+                          dropout_rng):
+    """(grads, metrics): value_and_grad of THE LM objective (CE mean +
+    aux_weight x sown aux losses, router-mass diagnostics attached) —
+    shared by the single-step, windowed, AND grad-accum wrappers so the
+    objective cannot drift between them."""
+
+    def loss_fn(p):
+        logits, aux, mass_sum, mass_n = _apply_collect_aux(
+            model, p, inputs, dropout_rng)
+        mask = jnp.ones(targets.shape, jnp.float32)
+        loss_sum, metrics = lm_loss_and_metrics(logits, targets, mask)
+        metrics = {**metrics,
+                   "router_mass_sum": jax.lax.stop_gradient(mass_sum),
+                   "router_mass_n": mass_n}
+        mean = loss_sum / jnp.maximum(metrics["count"], 1.0)
+        return mean + aux_weight * aux, metrics
+
+    (_, metrics), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params)
+    return grads, metrics
+
+
 def _lm_step_fn(model, tx, aux_weight: float) -> Callable:
     """THE pure LM train step shared by every jit wrapper (single-batch and
     indexed-window) — the lm twin of steps.py _train_step_fn, so the
@@ -103,21 +126,9 @@ def _lm_step_fn(model, tx, aux_weight: float) -> Callable:
 
     def step(state: TrainState, inputs, targets, rng):
         dropout_rng = jax.random.fold_in(rng, state.step)
-
-        def loss_fn(p):
-            logits, aux, mass_sum, mass_n = _apply_collect_aux(
-                model, p, inputs, dropout_rng)
-            mask = jnp.ones(targets.shape, jnp.float32)
-            loss_sum, metrics = lm_loss_and_metrics(logits, targets, mask)
-            metrics = {**metrics,
-                       "router_mass_sum": jax.lax.stop_gradient(mass_sum),
-                       "router_mass_n": mass_n}
-            mean = loss_sum / jnp.maximum(metrics["count"], 1.0)
-            return mean + aux_weight * aux, ({}, metrics)
-
-        (_, (stats, metrics)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
-        return _apply_update(tx, state, grads, stats, metrics)
+        grads, metrics = _lm_grads_and_metrics(
+            model, aux_weight, state.params, inputs, targets, dropout_rng)
+        return _apply_update(tx, state, grads, {}, metrics)
 
     return step
 
@@ -138,6 +149,48 @@ def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
     return jax.jit(_lm_step_fn(model, tx, aux_weight),
                    in_shardings=(None, batch_sh, batch_sh, repl),
                    out_shardings=None,
+                   donate_argnums=(0,) if donate else ())
+
+
+def make_lm_grad_accum_train_step(model, tx, mesh: Mesh,
+                                  data_axis: str = DATA_AXIS,
+                                  aux_weight: float = 0.01,
+                                  donate: bool = True) -> Callable:
+    """ONE optimizer step from K microbatches (gradient accumulation), the
+    LM twin of steps.py make_grad_accum_train_step.
+
+    signature: (state, inputs (K, B, L), targets (K, B, L), rng) -> (state,
+    metric sums over microbatches). Grads average over the K microbatches
+    inside a lax.scan, then apply once — for global token batches beyond
+    device memory. Equal microbatch sizes make the average of per-micro
+    means equal the full-batch mean; dropout folds a per-microbatch index
+    on top of the usual state.step fold.
+    """
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(None, data_axis))
+
+    def step(state: TrainState, inputs, targets, rng):
+        k = inputs.shape[0]
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def micro(carry, batch):
+            grads_acc, i = carry
+            mb_in, mb_tg = batch
+            grads, metrics = _lm_grads_and_metrics(
+                model, aux_weight, state.params, mb_in, mb_tg,
+                jax.random.fold_in(dropout_rng, i))
+            grads_acc = jax.tree.map(lambda a, g: a + g / k, grads_acc, grads)
+            return (grads_acc, i + 1), metrics
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             state.params)
+        (grads, _), metrics_k = jax.lax.scan(
+            micro, (zeros, jnp.int32(0)), (inputs, targets))
+        metrics = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
+        return _apply_update(tx, state, grads, {}, metrics)
+
+    return jax.jit(step, in_shardings=(None, batch_sh, batch_sh, repl),
+                   out_shardings=(None, repl),
                    donate_argnums=(0,) if donate else ())
 
 

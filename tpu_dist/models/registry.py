@@ -28,6 +28,10 @@ _REGISTRY: Dict[str, Tuple[Callable, str]] = {
     "resnet50": (resnet.ResNet50, "image"),
     "resnet101": (resnet.ResNet101, "image"),
     "resnet152": (resnet.ResNet152, "image"),
+    "resnext50_32x4d": (resnet.ResNeXt50_32x4d, "image"),
+    "resnext101_32x8d": (resnet.ResNeXt101_32x8d, "image"),
+    "wide_resnet50_2": (resnet.WideResNet50_2, "image"),
+    "wide_resnet101_2": (resnet.WideResNet101_2, "image"),
     "vgg11": (cnn_zoo.VGG11, "image"),
     "vgg16": (cnn_zoo.VGG16, "image"),
     "densenet121": (cnn_zoo.DenseNet121, "image"),

@@ -54,21 +54,29 @@ class BasicBlock(nn.Module):
 
 
 class Bottleneck(nn.Module):
-    """torchvision Bottleneck: 1x1 -> 3x3 -> 1x1 (expansion 4)."""
+    """torchvision Bottleneck: 1x1 -> 3x3 -> 1x1 (expansion 4).
+
+    ``groups``/``base_width`` follow torchvision's generalization: the inner
+    width is ``filters * base_width/64 * groups`` and the 3x3 conv is
+    grouped — resnext50_32x4d = (32, 4), wide_resnet50_2 = (1, 128)."""
 
     filters: int
     strides: Tuple[int, int] = (1, 1)
     expansion: int = 4
+    groups: int = 1
+    base_width: int = 64
     conv: ModuleDef = nn.Conv
     norm: ModuleDef = nn.BatchNorm
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (1, 1))(x)
+        width = int(self.filters * (self.base_width / 64.0)) * self.groups
+        y = self.conv(width, (1, 1))(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), self.strides, padding=[(1, 1), (1, 1)])(y)
+        y = self.conv(width, (3, 3), self.strides, padding=[(1, 1), (1, 1)],
+                      feature_group_count=self.groups)(y)
         y = self.norm()(y)
         y = nn.relu(y)
         y = self.conv(self.filters * self.expansion, (1, 1))(y)
@@ -136,3 +144,13 @@ ResNet34 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BasicBlock)
 ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=Bottleneck)
 ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3], block_cls=Bottleneck)
 ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3], block_cls=Bottleneck)
+ResNeXt50_32x4d = partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                          block_cls=partial(Bottleneck, groups=32,
+                                            base_width=4))
+ResNeXt101_32x8d = partial(ResNet, stage_sizes=[3, 4, 23, 3],
+                           block_cls=partial(Bottleneck, groups=32,
+                                             base_width=8))
+WideResNet50_2 = partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                         block_cls=partial(Bottleneck, base_width=128))
+WideResNet101_2 = partial(ResNet, stage_sizes=[3, 4, 23, 3],
+                          block_cls=partial(Bottleneck, base_width=128))
