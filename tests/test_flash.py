@@ -125,3 +125,17 @@ def test_flash_block_k_alias_conflict_raises():
         flash_attention_fn(block_k=256, recompute_block=128)
     # the alias alone still works
     assert flash_attention_fn(recompute_block=128) is not None
+
+
+def test_blockwise_non_divisible_length_fits_gcd():
+    """Blockwise follows the flash _blocks fit rule: a kv length that is a
+    multiple of 512 but not of the 1024 default shrinks to the gcd instead
+    of raising (the round-4 attn_block default bump must not break it)."""
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 96, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 96, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 96, 2, 16)), jnp.float32)
+    out = blockwise_attention_fn(64)(q, k, v)  # 96 % 64 != 0 -> gcd 32
+    ref = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

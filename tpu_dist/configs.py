@@ -121,7 +121,9 @@ class LMConfig:
     num_experts: int = 0           # MoE feed-forward with N experts (0=dense)
     router_top_k: int = 1          # 1 = Switch top-1, 2 = GShard top-2
     attn: str = "full"             # full | blockwise | flash (Pallas FA2)
-    attn_block: int = 512          # KV block for blockwise/flash
+    attn_block: int = 1024         # KV block for blockwise/flash (clamped
+                                   # to seq_len; 1024 measured ~20% faster
+                                   # than 512 for flash fwd+bwd on v5e)
     remat: bool = False            # jax.checkpoint each block (HBM lever)
     precision: str = "fp32"        # fp32 | bf16
 

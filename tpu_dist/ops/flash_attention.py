@@ -47,9 +47,15 @@ def blockwise_attention_fn(block_size: int = 512):
     def attn(q, k, v, *, causal: bool = True, q_offset=0, kv_offset=0):
         b, lq, h, d = q.shape
         lk = k.shape[1]
+        # same fit rule as the flash kernels (_blocks): clamp to the kv
+        # length, shrink to gcd when it doesn't divide (lk=1536 with
+        # block 1024 -> 512), so the shared attn_block default works here
         blk = min(block_size, lk)
         if lk % blk:
-            raise ValueError(f"kv length {lk} not divisible by block {blk}")
+            blk = math.gcd(blk, lk)
+        if blk < 1:
+            raise ValueError(f"kv length {lk} has no usable block "
+                             f"<= {block_size}")
         nk = lk // blk
         scale = 1.0 / math.sqrt(d)
 
@@ -403,7 +409,7 @@ def _fa_backward(q, k, v, out, lse, g, causal, q_offset, kv_offset,
     return unfold(dq, lq), unfold(dk, lk), unfold(dv, lk)
 
 
-def flash_attention_fn(block_q: int = 512, block_k: int | None = None,
+def flash_attention_fn(block_q: int = 1024, block_k: int | None = None,
                        interpret: bool | None = None,
                        recompute_block: int | None = None):
     """Returns attn(q, k, v, causal=True, q_offset=0, kv_offset=0) backed by
@@ -415,7 +421,9 @@ def flash_attention_fn(block_q: int = 512, block_k: int | None = None,
     code runs in the CPU test mesh. ``recompute_block`` is a legacy alias
     for ``block_k`` (the round-2 kernel's recompute granularity); passing
     both is an error rather than a silent override (ADVICE r3). ``block_k``
-    defaults to 512.
+    defaults to 1024 — a round-4 on-chip sweep at B8/L2048/H16/D64 measured
+    1024x1024 ~20% faster fwd+bwd than the round-3 512x512 default (blocks
+    clamp to the sequence length, so short sequences are unaffected).
     """
     if recompute_block is not None:
         if block_k is not None:
@@ -423,7 +431,7 @@ def flash_attention_fn(block_q: int = 512, block_k: int | None = None,
                              "recompute_block, not both")
         block_k = recompute_block
     if block_k is None:
-        block_k = 512
+        block_k = 1024
 
     def pick_interpret():
         if interpret is not None:
