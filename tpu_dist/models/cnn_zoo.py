@@ -1,11 +1,13 @@
-"""Non-ResNet CNN plans: VGG, DenseNet, MobileNetV2, SqueezeNet (C2 breadth).
+"""Non-ResNet CNN plans: VGG, DenseNet, MobileNetV2, SqueezeNet,
+ShuffleNetV2 (C2 breadth).
 
 The reference's factory accepts ANY lowercase torchvision callable by name
 (reference 1.dataparallel.py:23-24), so its catalog includes families beyond
 ResNet.  These families prove the registry generalizes — the torchvision
 layer plans (vgg16 with BatchNorm, densenet121, mobilenet_v2's inverted
-residuals with depthwise convs, squeezenet1_1's fire modules) rebuilt
-TPU-first in the same idiom as tpu_dist.models.resnet:
+residuals with depthwise convs, squeezenet1_1's fire modules,
+shufflenet_v2_x1_0's channel-split/shuffle units) rebuilt TPU-first in the
+same idiom as tpu_dist.models.resnet:
 
 * NHWC layout, flax.linen, configurable compute dtype with fp32 norm
   statistics (SyncBN semantics under a data-sharded jit);
@@ -181,6 +183,85 @@ class MobileNetV2(nn.Module):
         x = jnp.clip(norm(name="bn_head")(x), 0.0, 6.0)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(0.2, deterministic=not train, name="drop")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def _channel_shuffle(x, groups: int = 2):
+    """ShuffleNet channel shuffle: interleave the two branch halves so
+    information crosses the split at every unit."""
+    b, h, w, c = x.shape
+    return (x.reshape(b, h, w, groups, c // groups)
+            .swapaxes(3, 4).reshape(b, h, w, c))
+
+
+class _ShuffleUnit(nn.Module):
+    """ShuffleNetV2 unit. stride 1: channel-split, right branch
+    1x1 -> 3x3 dw -> 1x1, concat, shuffle. stride 2: both branches
+    downsample the full input (left 3x3 dw -> 1x1; right as above)."""
+
+    out_ch: int
+    stride: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        branch = self.out_ch // 2
+
+        def right(h, name):
+            h = nn.relu(norm(name=f"{name}_bn1")(
+                conv(branch, (1, 1), name=f"{name}_pw1")(h)))
+            h = norm(name=f"{name}_bn2")(
+                conv(branch, (3, 3), (self.stride, self.stride),
+                     padding=[(1, 1), (1, 1)], feature_group_count=branch,
+                     name=f"{name}_dw")(h))
+            return nn.relu(norm(name=f"{name}_bn3")(
+                conv(branch, (1, 1), name=f"{name}_pw2")(h)))
+
+        if self.stride == 1:
+            left, rest = jnp.split(x, 2, axis=-1)
+            out = jnp.concatenate([left, right(rest, "r")], axis=-1)
+        else:
+            in_ch = x.shape[-1]
+            l = norm(name="l_bn1")(
+                conv(in_ch, (3, 3), (2, 2), padding=[(1, 1), (1, 1)],
+                     feature_group_count=in_ch, name="l_dw")(x))
+            l = nn.relu(norm(name="l_bn2")(conv(branch, (1, 1),
+                                                name="l_pw")(l)))
+            out = jnp.concatenate([l, right(x, "r")], axis=-1)
+        return _channel_shuffle(out)
+
+
+class ShuffleNetV2(nn.Module):
+    """torchvision shufflenet_v2_x1_0 plan: 24-ch stem + 3 stages of
+    (downsample + repeat) shuffle units (116/232/464 ch, repeats 4/8/4),
+    1024-ch 1x1 head conv, GAP + classifier."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+    stage_out: Sequence[int] = (116, 232, 464)
+    stage_repeats: Sequence[int] = (4, 8, 4)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        x = nn.relu(norm(name="bn1")(
+            nn.Conv(24, (3, 3), (2, 2), padding=[(1, 1), (1, 1)],
+                    use_bias=False, dtype=self.dtype, name="conv1")(x)))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for si, (ch, n) in enumerate(zip(self.stage_out, self.stage_repeats)):
+            for i in range(n):
+                x = _ShuffleUnit(ch, 2 if i == 0 else 1, self.dtype,
+                                 name=f"stage{si}_unit{i}")(x, train)
+        x = nn.relu(norm(name="bn5")(
+            nn.Conv(1024, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="conv5")(x)))
+        x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
         return x.astype(jnp.float32)
 
