@@ -57,7 +57,7 @@ def test_param_count_matches_torchvision(arch):
 
 @pytest.mark.parametrize("arch", ["vgg16", "vgg11", "densenet121",
                                   "mobilenet_v2", "squeezenet1_1",
-                                  "shufflenet_v2_x1_0"])
+                                  "shufflenet_v2_x1_0", "efficientnet_b0"])
 def test_cnn_zoo_forward_shape(arch):
     """Non-ResNet CNN plans (registry-breadth parity with the reference's
     any-torchvision-arch factory, 1.dataparallel.py:23-24): same input sizes
@@ -82,7 +82,7 @@ def test_resnet_variant_forward_shape(arch):
 
 
 @pytest.mark.parametrize("arch", ["mobilenet_v2", "squeezenet1_1",
-                                  "shufflenet_v2_x1_0",
+                                  "shufflenet_v2_x1_0", "efficientnet_b0",
                                   "resnext50_32x4d", "wide_resnet50_2"])
 def test_mobile_class_param_count_matches_torchvision(arch):
     """The round-4 catalog additions map 1:1 onto torchvision's layer plans

@@ -1,13 +1,14 @@
 """Non-ResNet CNN plans: VGG, DenseNet, MobileNetV2, SqueezeNet,
-ShuffleNetV2 (C2 breadth).
+ShuffleNetV2, EfficientNet (C2 breadth).
 
 The reference's factory accepts ANY lowercase torchvision callable by name
 (reference 1.dataparallel.py:23-24), so its catalog includes families beyond
 ResNet.  These families prove the registry generalizes — the torchvision
 layer plans (vgg16 with BatchNorm, densenet121, mobilenet_v2's inverted
 residuals with depthwise convs, squeezenet1_1's fire modules,
-shufflenet_v2_x1_0's channel-split/shuffle units) rebuilt TPU-first in the
-same idiom as tpu_dist.models.resnet:
+shufflenet_v2_x1_0's channel-split/shuffle units, efficientnet_b0's
+MBConv + squeeze-excite + stochastic depth) rebuilt TPU-first in the same
+idiom as tpu_dist.models.resnet:
 
 * NHWC layout, flax.linen, configurable compute dtype with fp32 norm
   statistics (SyncBN semantics under a data-sharded jit);
@@ -23,6 +24,7 @@ from functools import partial
 from typing import Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 
@@ -181,6 +183,102 @@ class MobileNetV2(nn.Module):
         x = nn.Conv(1280, (1, 1), use_bias=False, dtype=self.dtype,
                     name="head_conv")(x)
         x = jnp.clip(norm(name="bn_head")(x), 0.0, 6.0)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.2, deterministic=not train, name="drop")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+class _SqueezeExcite(nn.Module):
+    """EfficientNet SE: global pool -> 1x1 reduce (SiLU) -> 1x1 expand
+    (sigmoid) -> scale. ``reduce_ch`` follows torchvision: the block's
+    INPUT channels // 4 (not the expanded width)."""
+
+    reduce_ch: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.silu(nn.Conv(self.reduce_ch, (1, 1), dtype=self.dtype,
+                            name="fc1")(s))
+        s = nn.sigmoid(nn.Conv(x.shape[-1], (1, 1), dtype=self.dtype,
+                               name="fc2")(s))
+        return x * s
+
+
+class _MBConv(nn.Module):
+    """EfficientNet MBConv: [1x1 expand] -> kxk depthwise -> SE -> 1x1
+    project (linear), residual with stochastic depth when shapes match."""
+
+    out_ch: int
+    expand: int
+    kernel: int
+    stride: int
+    sd_rate: float  # stochastic-depth drop prob for this block
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-3, dtype=jnp.float32)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        in_ch = x.shape[-1]
+        h = x
+        if self.expand != 1:
+            h = nn.silu(norm(name="bn_expand")(
+                conv(in_ch * self.expand, (1, 1), name="expand")(h)))
+        ch = h.shape[-1]
+        pad = self.kernel // 2
+        h = nn.silu(norm(name="bn_dw")(
+            conv(ch, (self.kernel, self.kernel),
+                 (self.stride, self.stride), padding=[(pad, pad)] * 2,
+                 feature_group_count=ch, name="dw")(h)))
+        h = _SqueezeExcite(max(1, in_ch // 4), self.dtype, name="se")(h)
+        h = norm(name="bn_project")(
+            conv(self.out_ch, (1, 1), name="project")(h))
+        if self.stride == 1 and in_ch == self.out_ch:
+            if train and self.sd_rate > 0:
+                # stochastic depth (row-wise): drop the residual branch
+                keep = 1.0 - self.sd_rate
+                rng = self.make_rng("dropout")
+                mask = jax.random.bernoulli(
+                    rng, keep, (h.shape[0], 1, 1, 1)).astype(h.dtype)
+                h = h * mask / keep
+            h = x + h
+        return h
+
+
+class EfficientNet(nn.Module):
+    """torchvision efficientnet_b0 plan: 32-ch SiLU stem, seven MBConv
+    stages (expand, channels, repeats, stride, kernel), 1280-ch head."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+    plan: Sequence = ((1, 16, 1, 1, 3), (6, 24, 2, 2, 3), (6, 40, 2, 2, 5),
+                      (6, 80, 3, 2, 3), (6, 112, 3, 1, 5),
+                      (6, 192, 4, 2, 5), (6, 320, 1, 1, 3))
+    sd_max: float = 0.2  # stochastic depth ramps linearly to this
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-3, dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        x = nn.silu(norm(name="bn_stem")(
+            nn.Conv(32, (3, 3), (2, 2), padding=[(1, 1), (1, 1)],
+                    use_bias=False, dtype=self.dtype, name="stem")(x)))
+        total = sum(n for _, _, n, _, _ in self.plan)
+        bi = 0
+        for si, (t, c, n, s, k) in enumerate(self.plan):
+            for i in range(n):
+                x = _MBConv(c, t, k, s if i == 0 else 1,
+                            self.sd_max * bi / total, self.dtype,
+                            name=f"stage{si}_block{i}")(x, train)
+                bi += 1
+        x = nn.silu(norm(name="bn_head")(
+            nn.Conv(1280, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="head_conv")(x)))
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(0.2, deterministic=not train, name="drop")(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)

@@ -38,6 +38,7 @@ _REGISTRY: Dict[str, Tuple[Callable, str]] = {
     "mobilenet_v2": (cnn_zoo.MobileNetV2, "image"),
     "squeezenet1_1": (cnn_zoo.SqueezeNet, "image"),
     "shufflenet_v2_x1_0": (cnn_zoo.ShuffleNetV2, "image"),
+    "efficientnet_b0": (cnn_zoo.EfficientNet, "image"),
     "lenet": (lenet.LeNet, "image"),
     "mnist_net": (lenet.LeNet, "image"),  # reference 5.2 'Net' alias
     "vit_tiny": (vit.ViTTiny, "image"),
