@@ -101,6 +101,7 @@ def lm_bench():
     k = int(os.environ.get("BENCH_STEPS_PER_WINDOW",
                            os.environ.get("BENCH_STEPS", "20")))
     trials = int(os.environ.get("BENCH_TRIALS", "3"))
+    loss_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0"))
 
     if attn_kind == "flash":
         from tpu_dist.ops.flash_attention import flash_attention_fn
@@ -120,7 +121,8 @@ def lm_bench():
     tx = make_optimizer(1e-3, 0.9, 0.0, steps_per_epoch=10 ** 6)
     state = jax.device_put(TrainState.create(params, {}, tx),
                            replicated(mesh))
-    window = make_lm_indexed_multi_train_step(model, tx, mesh)
+    window = make_lm_indexed_multi_train_step(model, tx, mesh,
+                                              loss_chunk=loss_chunk)
 
     rng = np.random.default_rng(0)
     rows = rng.integers(0, vocab, (batch, L + 1)).astype(np.int32)
@@ -151,7 +153,9 @@ def lm_bench():
     tflops = tok_chip * flops_per_token / 1e12
     mfu = tflops / peak if peak else None
     print(f"lm {layers}L/d{d_model} L={L} b/chip={batch // n_chips} "
-          f"attn={attn_kind}: {tok_chip:,.0f} tok/s/chip, trials "
+          f"attn={attn_kind}"
+          + (f" loss_chunk={loss_chunk}" if loss_chunk else "")
+          + f": {tok_chip:,.0f} tok/s/chip, trials "
           f"{[round(r / n_chips) for r in rates]}"
           + (f", {tflops:.1f} TFLOP/s/chip" if tflops else "")
           + (f", MFU {mfu * 100:.1f}% of {peak} TF peak" if mfu else ""),

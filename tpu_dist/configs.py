@@ -125,6 +125,10 @@ class LMConfig:
                                    # to seq_len; 1024 measured ~20% faster
                                    # than 512 for flash fwd+bwd on v5e)
     remat: bool = False            # jax.checkpoint each block (HBM lever)
+    loss_chunk: int = 0            # >0: chunked head+CE (ops.fused_xent) —
+                                   # the (B,L,V) logits never materialize;
+                                   # N rows of logits at a time, backward
+                                   # recomputes (jit + sp modes)
     precision: str = "fp32"        # fp32 | bf16
 
     # -- schedule

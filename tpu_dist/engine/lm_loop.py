@@ -157,7 +157,7 @@ class LMTrainer:
             from tpu_dist.engine.lm_steps import (
                 make_lm_grad_accum_train_step)
             self.train_step = make_lm_grad_accum_train_step(
-                self.model, self.tx, self.mesh)
+                self.model, self.tx, self.mesh, loss_chunk=cfg.loss_chunk)
         rows_bytes = (len(self.train_ds) + len(self.val_ds)) * \
             (cfg.seq_len + 1) * 4
         fits = rows_bytes <= int(os.environ.get("TPU_DIST_DEVICE_DATA_MAX",
@@ -190,14 +190,16 @@ class LMTrainer:
                     make_lm_sp_indexed_eval_step,
                     make_lm_sp_indexed_multi_train_step)
                 self.window_step = make_lm_sp_indexed_multi_train_step(
-                    self._sp_ctor, self.tx, self.mesh)
+                    self._sp_ctor, self.tx, self.mesh,
+                    loss_chunk=cfg.loss_chunk)
                 self.window_eval_step = make_lm_sp_indexed_eval_step(
-                    self._sp_ctor, self.mesh)
+                    self._sp_ctor, self.mesh, loss_chunk=cfg.loss_chunk)
             else:
                 self.window_step = make_lm_indexed_multi_train_step(
-                    self.model, self.tx, self.mesh)
+                    self.model, self.tx, self.mesh,
+                    loss_chunk=cfg.loss_chunk)
                 self.window_eval_step = make_lm_indexed_eval_step(
-                    self.model, self.mesh)
+                    self.model, self.mesh, loss_chunk=cfg.loss_chunk)
         elif self.k > 1:
             raise ValueError(
                 "steps_per_dispatch > 1 needs the device-resident row path "
@@ -323,6 +325,10 @@ class LMTrainer:
             if cfg.pp_schedule not in ("gpipe", "1f1b"):
                 raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r} "
                                  "(gpipe|1f1b)")
+            if cfg.loss_chunk:
+                self.log("warning: --loss-chunk applies to the jit/sp modes; "
+                         "pipeline schedules keep their per-stage head path "
+                         "— ignored")
             make_pp = (make_lm_pp_1f1b_train_step
                        if cfg.pp_schedule == "1f1b"
                        else make_lm_pp_train_step)
@@ -338,14 +344,17 @@ class LMTrainer:
                                        self._model_ctor_kw.items()
                                        if k != "attn_fn"})
             self._sp_ctor = ctor  # the windowed sp steps rebind it per-axis
-            self.train_step = make_lm_sp_train_step(ctor, self.tx, self.mesh)
-            self.eval_step = make_lm_sp_eval_step(ctor, self.mesh)
+            self.train_step = make_lm_sp_train_step(
+                ctor, self.tx, self.mesh, loss_chunk=cfg.loss_chunk)
+            self.eval_step = make_lm_sp_eval_step(
+                ctor, self.mesh, loss_chunk=cfg.loss_chunk)
             self.data_spec = P("data", "seq")
             self.valid_spec = P("data")
         else:
-            self.train_step = make_lm_train_step(self.model, self.tx,
-                                                 self.mesh)
-            self.eval_step = make_lm_eval_step(self.model, self.mesh)
+            self.train_step = make_lm_train_step(
+                self.model, self.tx, self.mesh, loss_chunk=cfg.loss_chunk)
+            self.eval_step = make_lm_eval_step(
+                self.model, self.mesh, loss_chunk=cfg.loss_chunk)
             self.data_spec = P("data")
             self.valid_spec = P("data")
 

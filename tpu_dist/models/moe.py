@@ -187,7 +187,8 @@ class MoETransformerLM(nn.Module):
                          # same HBM lever the dense LM has)
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True, pos_offset=0):
+    def __call__(self, tokens, train: bool = True, pos_offset=0,
+                 return_features: bool = False):
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                      name="tok_emb")(tokens)
         pos = pos_offset + jnp.arange(tokens.shape[1])
@@ -200,6 +201,9 @@ class MoETransformerLM(nn.Module):
                           self.attn_fn, self.router_top_k,
                           name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if return_features:
+            # chunked-loss path (ops.fused_xent): head applied per row-chunk
+            return x
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                           name="lm_head")(x)
         return logits.astype(jnp.float32)

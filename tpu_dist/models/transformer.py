@@ -117,11 +117,15 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, pos_offset=0,
-                 decode: bool = False):
+                 decode: bool = False, return_features: bool = False):
         # pos_offset: global position of this shard's first token (sequence
         # parallelism passes axis_index * shard_len, a traced scalar; 0 when
         # the sequence axis is unsharded). decode=True enables the per-block
         # KV cache ('cache' collection) for autoregressive generation.
+        # return_features=True skips lm_head and returns the (B, L, D)
+        # post-ln_f features — the chunked-loss path (ops.fused_xent) applies
+        # the head itself, one row-chunk at a time, so the full (B, L, V)
+        # logits never materialize.
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                      name="tok_emb")(tokens)
         pos = pos_offset + jnp.arange(tokens.shape[1])
@@ -133,6 +137,8 @@ class TransformerLM(nn.Module):
             x = block_cls(self.num_heads, self.dtype, self.attn_fn,
                           name=f"block{i}")(x, train, decode)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if return_features:
+            return x
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                           name="lm_head")(x)
         return logits.astype(jnp.float32)

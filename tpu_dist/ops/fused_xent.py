@@ -1,0 +1,139 @@
+"""Chunked vocab cross-entropy: the LM-head memory/bandwidth lever.
+
+The straightforward LM loss (engine.lm_steps.lm_loss_and_metrics) first
+materializes the full (B, L, V) fp32 logits, then log_softmax's them — at the
+bench geometry (B8, L2048, V32k) that is ~2 GB of HBM written by the head
+matmul, read+written again by the softmax, and stashed for the backward pass.
+The reference never hits this (it trains CNNs with a 10-to-1000-way head:
+/root/reference/1.dataparallel.py); a 32k-vocab LM pays it every step.
+
+:func:`chunked_softmax_xent` computes the identical loss without ever holding
+more than one (chunk, V) logits tile:
+
+* forward — a ``lax.scan`` over row chunks of the flattened (B*L, D)
+  features: each iteration does the chunk's head matmul (fp32 accumulation on
+  the MXU), reduces it to per-row logsumexp / target-logit / argmax-hit, and
+  discards the tile. Only the (N,) fp32 logsumexp survives as a residual.
+* backward — ``jax.custom_vjp``: a second scan recomputes each chunk's
+  logits, forms softmax-minus-onehot against the SAVED logsumexp (bitwise the
+  forward's normalizer, no drift), and accumulates d_features rows and the
+  (D, V) head-weight cotangent in fp32.
+
+Peak extra memory is O(chunk * V + D * V) instead of O(B * L * V), and the
+logits never round-trip HBM in fp32 — the same recompute-what's-cheap trade
+the flash-attention kernels make, applied to the other big tile in the model.
+
+The head matmul runs in ``compute_dtype`` (bf16 under the bf16 policy) with
+fp32 accumulation — slightly MORE accurate than the unfused path, which
+rounds the Dense output to bf16 before upcasting for the softmax.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_stats(x_c, w, t_c, compute_dtype):
+    """One chunk's (logsumexp, target-logit, argmax==target). The backward
+    does NOT reuse this — it rebuilds the logits tile and normalizes against
+    the forward's saved lse, so fwd/bwd softmax agree bitwise."""
+    logits = jnp.dot(x_c.astype(compute_dtype), w.astype(compute_dtype),
+                     preferred_element_type=jnp.float32)        # (C, V) fp32
+    m = jnp.max(logits, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+    tgt = jnp.take_along_axis(logits, t_c[:, None], axis=-1)[:, 0]
+    hit = (jnp.argmax(logits, axis=-1) == t_c).astype(jnp.float32)
+    return lse, tgt, hit
+
+
+def _pad_rows(a, n_pad):
+    return a if n_pad == 0 else jnp.pad(a, [(0, n_pad)] + [(0, 0)] * (a.ndim - 1))
+
+
+def _forward(x, w, targets, mask, chunk, compute_dtype):
+    b, l, d = x.shape
+    n = b * l
+    xf = x.reshape(n, d)
+    tf = targets.reshape(n)
+    mf = mask.reshape(n).astype(jnp.float32)
+    chunk = max(1, min(chunk, n))
+    n_pad = (-n) % chunk
+    xf_p = _pad_rows(xf, n_pad)
+    tf_p = _pad_rows(tf, n_pad)
+    mf_p = _pad_rows(mf, n_pad)       # padded rows carry mask 0 -> no effect
+    k = (n + n_pad) // chunk
+
+    def body(sums, blk):
+        x_c, t_c, m_c = blk
+        lse, tgt, hit = _chunk_stats(x_c, w, t_c, compute_dtype)
+        loss_s, corr_s = sums
+        return (loss_s + jnp.sum((lse - tgt) * m_c),
+                corr_s + jnp.sum(hit * m_c)), lse
+
+    (loss_sum, correct), lse_all = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)),
+        (xf_p.reshape(k, chunk, d), tf_p.reshape(k, chunk),
+         mf_p.reshape(k, chunk)))
+    return loss_sum, correct, lse_all, (xf_p, tf_p, mf_p, n_pad)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def chunked_softmax_xent(x, w, targets, mask, chunk=1024,
+                         compute_dtype=jnp.float32):
+    """(loss_sum, correct1) over masked positions, without full logits.
+
+    x (B, L, D) features after the final norm; w (D, V) lm_head kernel;
+    targets (B, L) int; mask (B, L). Differentiable in x and w only; the
+    metrics output carries no gradient. Matches
+    ``lm_loss_and_metrics(x @ w, targets, mask)`` to fp32 accumulation order.
+    """
+    loss_sum, correct, _, _ = _forward(x, w, targets, mask, chunk,
+                                       compute_dtype)
+    return loss_sum, correct
+
+
+def _fwd(x, w, targets, mask, chunk, compute_dtype):
+    loss_sum, correct, lse_all, (xf_p, tf_p, mf_p, n_pad) = _forward(
+        x, w, targets, mask, chunk, compute_dtype)
+    res = (xf_p, w, tf_p, mf_p, lse_all, x.shape, n_pad)
+    return (loss_sum, correct), res
+
+
+def _bwd(chunk, compute_dtype, res, g):
+    g_loss = g[0]  # cotangent of loss_sum; correct1 carries no gradient
+    xf_p, w, tf_p, mf_p, lse_all, x_shape, n_pad = res
+    n_rows = xf_p.shape[0]
+    c = max(1, min(chunk, x_shape[0] * x_shape[1]))
+    k = n_rows // c
+    d, v = w.shape
+    cd = compute_dtype
+
+    def body(dw_acc, blk):
+        x_c, t_c, m_c, lse = blk
+        logits = jnp.dot(x_c.astype(cd), w.astype(cd),
+                         preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lse[:, None])                     # softmax, fp32
+        scale = (m_c * g_loss)[:, None]
+        dlogits = (p - jax.nn.one_hot(t_c, v, dtype=jnp.float32)) * scale
+        dl_c = dlogits.astype(cd)
+        dx_c = jnp.dot(dl_c, w.astype(cd).T,
+                       preferred_element_type=jnp.float32)
+        dw_acc = dw_acc + jnp.dot(x_c.astype(cd).T, dl_c,
+                                  preferred_element_type=jnp.float32)
+        return dw_acc, dx_c
+
+    dw, dx_chunks = jax.lax.scan(
+        body, jnp.zeros((d, v), jnp.float32),
+        (xf_p.reshape(k, c, d), tf_p.reshape(k, c), mf_p.reshape(k, c),
+         lse_all))
+    dx = dx_chunks.reshape(n_rows, d)
+    if n_pad:
+        dx = dx[:n_rows - n_pad]
+    return (dx.reshape(x_shape).astype(xf_p.dtype), dw.astype(w.dtype),
+            None, None)
+
+
+chunked_softmax_xent.defvjp(_fwd, _bwd)
