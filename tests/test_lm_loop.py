@@ -239,3 +239,21 @@ def test_lm_max_steps_caps_run():
     cfg = LMConfig(max_steps=3, steps_per_dispatch=2, **TINY)
     tr = _run(cfg)
     assert int(jax.device_get(tr.state.step)) == 3
+
+
+def test_lm_adamw_trains_and_resumes(tmp_path):
+    """--optimizer adamw: a checkpoint/resume boundary after epoch 1
+    continues the EXACT 2-epoch trajectory (the mu/nu moments ride in the
+    generic optax state the checkpoint already round-trips)."""
+    kw = dict(TINY, lr=3e-3, optimizer="adamw")
+    base = {k: v for k, v in kw.items() if k != "epochs"}
+
+    full = _run(LMConfig(epochs=2, **base))
+    v_full, _ = _params_vec(full)
+
+    _run(LMConfig(checkpoint_dir=str(tmp_path / "ck"), epochs=1, **base))
+    res = _run(LMConfig(
+        resume=str(tmp_path / "ck" / "lm-checkpoint.msgpack"),
+        epochs=2, **base))
+    v_res, _ = _params_vec(res)
+    np.testing.assert_allclose(v_res, v_full, rtol=1e-6, atol=1e-7)

@@ -85,3 +85,38 @@ def test_no_weight_decay_skips_decay_term():
     u, _ = tx.update(g, tx.init(params), params)
     # without wd the update ignores the (huge) param values entirely
     np.testing.assert_allclose(np.asarray(u["w"]), -0.1 * np.ones(4), rtol=1e-6)
+
+
+def test_adamw_update_matches_torch_adamw():
+    """kind='adamw' reproduces torch.optim.AdamW (decoupled wd) step for
+    step at matching hyperparameters."""
+    torch = pytest.importorskip("torch")
+
+    w0 = np.random.default_rng(2).normal(size=(5, 3)).astype(np.float32)
+    g = np.random.default_rng(3).normal(size=(5, 3)).astype(np.float32)
+
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    opt = torch.optim.AdamW([tw], lr=0.01, betas=(0.9, 0.95), eps=1e-8,
+                            weight_decay=0.1)
+    for _ in range(3):
+        opt.zero_grad()
+        tw.grad = torch.tensor(g.copy())
+        opt.step()
+
+    tx = make_optimizer(0.01, weight_decay=0.1, kind="adamw",
+                        b1=0.9, b2=0.95, eps=1e-8,
+                        schedule=lambda s: 0.01)
+    params = {"w": jnp.asarray(w0)}
+    opt_state = tx.init(params)
+    for _ in range(3):
+        updates, opt_state = tx.update({"w": jnp.asarray(g)}, opt_state,
+                                       params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+
+    np.testing.assert_allclose(np.asarray(params["w"]), tw.detach().numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_unknown_optimizer_kind_raises():
+    with pytest.raises(ValueError, match="sgd|adamw"):
+        make_optimizer(0.1, kind="rmsprop")

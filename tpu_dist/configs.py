@@ -42,8 +42,11 @@ class TrainConfig:
     weight_decay: float = 1e-4
     lr_step_epochs: int = 30           # x0.1 every N epochs (1.dataparallel.py:332-336)
     lr_scale_by_world: bool = False    # horovod-style lr x world_size (5.2...py:159-171)
-    optimizer: str = "sgd"             # sgd (optax) | fused_sgd (Pallas kernel,
+    optimizer: str = "sgd"             # sgd | adamw | fused_sgd (Pallas kernel,
                                        # apex fused-optimizer analog)
+    adam_b1: float = 0.9               # adamw betas/eps; b2 defaults to the
+    adam_b2: float = 0.999             # image convention here (the LM config
+    adam_eps: float = 1e-8             # defaults to the LM one, 0.95)
 
     # -- loop control (reference 1.dataparallel.py:57-70)
     print_freq: int = 10
@@ -136,8 +139,13 @@ class LMConfig:
     max_steps: int = 0             # stop after N optimizer steps (0 = off;
                                    # smoke tests / fixed-step runs)
     batch_size: int = 16           # GLOBAL batch in sequences
+    optimizer: str = "sgd"         # sgd | adamw (decoupled, b2=0.95 LM
+                                   # convention — ops.optim.make_optimizer)
     lr: float = 3e-2
     momentum: float = 0.9
+    adam_b1: float = 0.9
+    adam_b2: float = 0.95
+    adam_eps: float = 1e-8
     weight_decay: float = 0.0
     lr_schedule: str = "constant"  # constant | cosine | step, each with
                                    # linear warmup (ops.optim.lm_lr_schedule;

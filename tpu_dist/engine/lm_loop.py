@@ -54,6 +54,12 @@ class LMTrainer:
         self.cfg = cfg
         if cfg.resume and not os.path.exists(cfg.resume):
             raise FileNotFoundError(f"--resume checkpoint not found: {cfg.resume}")
+        if cfg.optimizer not in ("sgd", "adamw"):
+            # fail fast, BEFORE corpus/model setup (the image Trainer's
+            # contract; fused_sgd is image-only — its Pallas kernel assumes
+            # the SGD update form)
+            raise ValueError(f"unknown optimizer {cfg.optimizer!r} "
+                             "(sgd|adamw)")
         mesh_shape = cfg.mesh_shape or (jax.device_count(),)
         self.mesh = mesh if mesh is not None else make_mesh(
             tuple(mesh_shape), tuple(cfg.mesh_axes))
@@ -117,7 +123,9 @@ class LMTrainer:
             total_steps=total_steps, steps_per_epoch=self.steps_per_epoch,
             step_epochs=cfg.lr_step_epochs, min_frac=cfg.lr_min_frac)
         self.tx = make_optimizer(cfg.lr, cfg.momentum, cfg.weight_decay,
-                                 schedule=self.lr_schedule)
+                                 schedule=self.lr_schedule,
+                                 kind=cfg.optimizer, b1=cfg.adam_b1,
+                                 b2=cfg.adam_b2, eps=cfg.adam_eps)
         if self.use_pp:
             from tpu_dist.parallel.pp import stack_pipeline_params
             params = stack_pipeline_params(params, shape["stage"])

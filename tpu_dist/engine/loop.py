@@ -53,8 +53,9 @@ class Trainer:
         # fail fast on bad config, before device/model setup
         if cfg.resume and not os.path.exists(cfg.resume):
             raise FileNotFoundError(f"--resume checkpoint not found: {cfg.resume}")
-        if cfg.optimizer not in ("sgd", "fused_sgd"):
-            raise ValueError(f"unknown optimizer {cfg.optimizer!r} (sgd|fused_sgd)")
+        if cfg.optimizer not in ("sgd", "fused_sgd", "adamw"):
+            raise ValueError(f"unknown optimizer {cfg.optimizer!r} "
+                             "(sgd|fused_sgd|adamw)")
         from tpu_dist.models.registry import model_kind
         if model_kind(cfg.arch) != "image":
             raise ValueError(
@@ -105,7 +106,9 @@ class Trainer:
         else:
             self.tx = make_optimizer(
                 cfg.lr, cfg.momentum, cfg.weight_decay, self.steps_per_epoch,
-                cfg.lr_step_epochs, schedule=self.schedule)
+                cfg.lr_step_epochs, schedule=self.schedule,
+                kind=cfg.optimizer, b1=cfg.adam_b1, b2=cfg.adam_b2,
+                eps=cfg.adam_eps)
         loss_scale = (LossScaleState.create(cfg.loss_scale)
                       if cfg.loss_scale else None)
         state = TrainState.create(params, batch_stats, self.tx, loss_scale)

@@ -81,15 +81,25 @@ def lm_lr_schedule(base_lr: float, kind: str = "constant",
 
 def make_optimizer(lr: float, momentum: float = 0.9, weight_decay: float = 1e-4,
                    steps_per_epoch: int = 1, lr_step_epochs: int = 30,
-                   schedule: Optional[Callable] = None
+                   schedule: Optional[Callable] = None, kind: str = "sgd",
+                   b1: float = 0.9, b2: float = 0.95, eps: float = 1e-8
                    ) -> optax.GradientTransformation:
-    """torch.optim.SGD(momentum, weight_decay)-equivalent with step-decay LR.
+    """torch.optim.SGD(momentum, weight_decay)-equivalent with step-decay LR,
+    or decoupled AdamW (``kind='adamw'``) — the transformer-family default
+    the reference (image-only, SGD throughout) never needed. b2 defaults to
+    0.95, the large-LM convention, not torch's 0.999.
 
     Horovod's gradient_predivide_factor lives in the explicit-psum step
     (tpu_dist.engine.steps.make_shard_map_train_step), matching horovod's
     placement around the allreduce — NOT here, so it cannot double-apply.
     """
     sched = schedule or step_decay_schedule(lr, steps_per_epoch, lr_step_epochs)
+    if kind == "adamw":
+        # decoupled wd (AdamW): applied AFTER the adam scaling, with lr
+        return optax.adamw(learning_rate=sched, b1=b1, b2=b2, eps=eps,
+                           weight_decay=weight_decay)
+    if kind != "sgd":
+        raise ValueError(f"unknown optimizer kind {kind!r} (sgd|adamw)")
     chain = []
     if weight_decay:
         chain.append(optax.add_decayed_weights(weight_decay))
