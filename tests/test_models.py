@@ -55,9 +55,11 @@ def test_param_count_matches_torchvision(arch):
     assert ours == torch_params, f"{arch}: {ours} vs torchvision {torch_params}"
 
 
-@pytest.mark.parametrize("arch", ["vgg16", "vgg11", "densenet121",
+@pytest.mark.parametrize("arch", ["vgg16", "vgg11", "vgg13", "vgg19",
+                                  "densenet121", "densenet169",
                                   "mobilenet_v2", "squeezenet1_1",
-                                  "shufflenet_v2_x1_0", "efficientnet_b0"])
+                                  "squeezenet1_0", "shufflenet_v2_x1_0",
+                                  "shufflenet_v2_x0_5", "efficientnet_b0"])
 def test_cnn_zoo_forward_shape(arch):
     """Non-ResNet CNN plans (registry-breadth parity with the reference's
     any-torchvision-arch factory, 1.dataparallel.py:23-24): same input sizes
@@ -67,8 +69,39 @@ def test_cnn_zoo_forward_shape(arch):
     variables = m.init({"params": jax.random.PRNGKey(0)}, x, train=False)
     out = m.apply(variables, x, train=False)
     assert out.shape == (2, 10)
-    if arch != "squeezenet1_1":  # squeezenet's plan is BN-free upstream too
+    if not arch.startswith("squeezenet"):  # squeezenet is BN-free upstream
         assert "batch_stats" in variables  # BN plans carry running stats
+
+
+# torchvision's published trainable-parameter counts at 1000 classes —
+# checkable WITHOUT torchvision installed (this container has none), via
+# eval_shape so no compile happens. VGG/AlexNet are absent by design: their
+# GAP head replaces torchvision's fixed 7x7 flatten (module docstring).
+TORCHVISION_PARAMS = {
+    "densenet121": 7_978_856,
+    "densenet161": 28_681_000,
+    "densenet169": 14_149_480,
+    "densenet201": 20_013_928,
+    "squeezenet1_0": 1_248_424,
+    "squeezenet1_1": 1_235_496,
+    "shufflenet_v2_x0_5": 1_366_792,
+    "shufflenet_v2_x1_0": 2_278_604,
+    "shufflenet_v2_x1_5": 3_503_624,
+    "shufflenet_v2_x2_0": 7_393_996,
+    "mobilenet_v2": 3_504_872,
+    "efficientnet_b0": 5_288_548,
+}
+
+
+@pytest.mark.parametrize("arch", sorted(TORCHVISION_PARAMS))
+def test_param_count_matches_published(arch):
+    """Exact parameter parity with torchvision's published counts — the
+    strongest no-copy plan check available in a zero-egress container."""
+    m = create_model(arch, num_classes=1000)
+    v = jax.eval_shape(lambda: m.init({"params": jax.random.PRNGKey(0)},
+                                      jnp.zeros((1, 224, 224, 3)),
+                                      train=False))
+    assert _param_count(v["params"]) == TORCHVISION_PARAMS[arch]
 
 
 @pytest.mark.parametrize("arch", ["resnext50_32x4d", "wide_resnet50_2"])

@@ -334,14 +334,16 @@ class _ShuffleUnit(nn.Module):
 
 
 class ShuffleNetV2(nn.Module):
-    """torchvision shufflenet_v2_x1_0 plan: 24-ch stem + 3 stages of
-    (downsample + repeat) shuffle units (116/232/464 ch, repeats 4/8/4),
-    1024-ch 1x1 head conv, GAP + classifier."""
+    """torchvision shufflenet_v2 plan: 24-ch stem + 3 stages of
+    (downsample + repeat) shuffle units, 1x1 head conv, GAP + classifier.
+    Width multipliers are pure plans: x0_5 (48/96/192), x1_0 (116/232/464),
+    x1_5 (176/352/704), x2_0 (244/488/976 with a 2048 head)."""
 
     num_classes: int = 10
     dtype: jnp.dtype = jnp.float32
     stage_out: Sequence[int] = (116, 232, 464)
     stage_repeats: Sequence[int] = (4, 8, 4)
+    head_ch: int = 1024
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -357,11 +359,23 @@ class ShuffleNetV2(nn.Module):
                 x = _ShuffleUnit(ch, 2 if i == 0 else 1, self.dtype,
                                  name=f"stage{si}_unit{i}")(x, train)
         x = nn.relu(norm(name="bn5")(
-            nn.Conv(1024, (1, 1), use_bias=False, dtype=self.dtype,
+            nn.Conv(self.head_ch, (1, 1), use_bias=False, dtype=self.dtype,
                     name="conv5")(x)))
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
         return x.astype(jnp.float32)
+
+
+def _max_pool_ceil(x, k: int = 3, s: int = 2):
+    """torchvision's MaxPool2d(ceil_mode=True): pad the end of each spatial
+    dim (flax pads max_pool with -inf) so partial windows count. Load-bearing
+    for squeezenet1_0 even at 224px (its 54 -> 27 pool needs ceil; floor
+    gives 26) and for both versions on 32px CIFAR inputs."""
+    pads = []
+    for dim in x.shape[1:3]:
+        rem = (dim - k) % s
+        pads.append((0, s - rem if rem else 0))
+    return nn.max_pool(x, (k, k), strides=(s, s), padding=pads)
 
 
 class _Fire(nn.Module):
@@ -383,33 +397,48 @@ class _Fire(nn.Module):
         return jnp.concatenate([a, b], axis=-1)
 
 
+# torchvision fire sequences, pools marked 'M' (the VGG plan idiom):
+# 1_0 = 96-ch 7x7 stem, pools after fire4/fire8; 1_1 = 64-ch 3x3 stem,
+# pools after fire3/fire5. Fire numbering starts at 2 upstream.
+_SQUEEZE_PLANS = {
+    "1_0": [(16, 64, 64), (16, 64, 64), (32, 128, 128), "M",
+            (32, 128, 128), (48, 192, 192), (48, 192, 192),
+            (64, 256, 256), "M", (64, 256, 256)],
+    "1_1": [(16, 64, 64), (16, 64, 64), "M", (32, 128, 128),
+            (32, 128, 128), "M", (48, 192, 192), (48, 192, 192),
+            (64, 256, 256), (64, 256, 256)],
+}
+
+
 class SqueezeNet(nn.Module):
-    """torchvision squeezenet1_1 plan (fire modules, no BatchNorm, conv
-    classifier head with global average pooling)."""
+    """torchvision squeezenet plan (fire modules, no BatchNorm, conv
+    classifier head with global average pooling). ``version`` picks the
+    1.0 geometry or the lighter 1.1 (_SQUEEZE_PLANS)."""
 
     num_classes: int = 10
     dtype: jnp.dtype = jnp.float32
+    version: str = "1_1"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        # torchvision geometry: stem conv and pools are UNPADDED (at 224px
-        # the maps run 111 -> 55 -> 27 -> 13, identically here; ceil_mode
-        # and floor agree at every one of these sizes)
+        # torchvision geometry: stem conv and pools are UNPADDED, pools in
+        # ceil mode (1_1 at 224px: 111 -> 55 -> 27 -> 13 where floor would
+        # agree; 1_0's 109 -> 54 -> 27 chain and CIFAR 32px inputs both
+        # NEED the ceil — see _max_pool_ceil)
         fire = partial(_Fire, dtype=self.dtype)
         x = x.astype(self.dtype)
-        x = nn.relu(nn.Conv(64, (3, 3), (2, 2), padding="VALID",
-                            dtype=self.dtype, name="stem")(x))
-        x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        x = fire(16, 64, 64, name="fire2")(x)
-        x = fire(16, 64, 64, name="fire3")(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        x = fire(32, 128, 128, name="fire4")(x)
-        x = fire(32, 128, 128, name="fire5")(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        x = fire(48, 192, 192, name="fire6")(x)
-        x = fire(48, 192, 192, name="fire7")(x)
-        x = fire(64, 256, 256, name="fire8")(x)
-        x = fire(64, 256, 256, name="fire9")(x)
+        stem_ch, stem_k = (96, 7) if self.version == "1_0" else (64, 3)
+        x = nn.relu(nn.Conv(stem_ch, (stem_k, stem_k), (2, 2),
+                            padding="VALID", dtype=self.dtype,
+                            name="stem")(x))
+        x = _max_pool_ceil(x)
+        i = 2
+        for entry in _SQUEEZE_PLANS[self.version]:
+            if entry == "M":
+                x = _max_pool_ceil(x)
+            else:
+                x = fire(*entry, name=f"fire{i}")(x)
+                i += 1
         x = nn.Dropout(0.5, deterministic=not train, name="drop")(x)
         x = nn.relu(nn.Conv(self.num_classes, (1, 1), dtype=self.dtype,
                             name="head_conv")(x))
@@ -423,3 +452,17 @@ VGG16 = partial(VGG, plan=[64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
 VGG11 = partial(VGG, plan=[64, "M", 128, "M", 256, 256, "M",
                            512, 512, "M", 512, 512, "M"])
 DenseNet121 = partial(DenseNet, block_sizes=[6, 12, 24, 16])
+VGG13 = partial(VGG, plan=[64, 64, "M", 128, 128, "M", 256, 256, "M",
+                           512, 512, "M", 512, 512, "M"])
+VGG19 = partial(VGG, plan=[64, 64, "M", 128, 128, "M", 256, 256, 256, 256,
+                           "M", 512, 512, 512, 512, "M",
+                           512, 512, 512, 512, "M"])
+DenseNet169 = partial(DenseNet, block_sizes=[6, 12, 32, 32])
+DenseNet201 = partial(DenseNet, block_sizes=[6, 12, 48, 32])
+DenseNet161 = partial(DenseNet, block_sizes=[6, 12, 36, 24], growth=48,
+                      init_features=96)
+SqueezeNet1_0 = partial(SqueezeNet, version="1_0")
+ShuffleNetV2_x0_5 = partial(ShuffleNetV2, stage_out=(48, 96, 192))
+ShuffleNetV2_x1_5 = partial(ShuffleNetV2, stage_out=(176, 352, 704))
+ShuffleNetV2_x2_0 = partial(ShuffleNetV2, stage_out=(244, 488, 976),
+                            head_ch=2048)

@@ -33,11 +33,20 @@ _REGISTRY: Dict[str, Tuple[Callable, str]] = {
     "wide_resnet50_2": (resnet.WideResNet50_2, "image"),
     "wide_resnet101_2": (resnet.WideResNet101_2, "image"),
     "vgg11": (cnn_zoo.VGG11, "image"),
+    "vgg13": (cnn_zoo.VGG13, "image"),
     "vgg16": (cnn_zoo.VGG16, "image"),
+    "vgg19": (cnn_zoo.VGG19, "image"),
     "densenet121": (cnn_zoo.DenseNet121, "image"),
+    "densenet161": (cnn_zoo.DenseNet161, "image"),
+    "densenet169": (cnn_zoo.DenseNet169, "image"),
+    "densenet201": (cnn_zoo.DenseNet201, "image"),
     "mobilenet_v2": (cnn_zoo.MobileNetV2, "image"),
+    "squeezenet1_0": (cnn_zoo.SqueezeNet1_0, "image"),
     "squeezenet1_1": (cnn_zoo.SqueezeNet, "image"),
+    "shufflenet_v2_x0_5": (cnn_zoo.ShuffleNetV2_x0_5, "image"),
     "shufflenet_v2_x1_0": (cnn_zoo.ShuffleNetV2, "image"),
+    "shufflenet_v2_x1_5": (cnn_zoo.ShuffleNetV2_x1_5, "image"),
+    "shufflenet_v2_x2_0": (cnn_zoo.ShuffleNetV2_x2_0, "image"),
     "efficientnet_b0": (cnn_zoo.EfficientNet, "image"),
     "lenet": (lenet.LeNet, "image"),
     "mnist_net": (lenet.LeNet, "image"),  # reference 5.2 'Net' alias
