@@ -59,7 +59,10 @@ def test_param_count_matches_torchvision(arch):
                                   "densenet121", "densenet169",
                                   "mobilenet_v2", "squeezenet1_1",
                                   "squeezenet1_0", "shufflenet_v2_x1_0",
-                                  "shufflenet_v2_x0_5", "efficientnet_b0"])
+                                  "shufflenet_v2_x0_5", "efficientnet_b0",
+                                  "alexnet", "googlenet", "mnasnet1_0",
+                                  "mobilenet_v3_large",
+                                  "mobilenet_v3_small"])
 def test_cnn_zoo_forward_shape(arch):
     """Non-ResNet CNN plans (registry-breadth parity with the reference's
     any-torchvision-arch factory, 1.dataparallel.py:23-24): same input sizes
@@ -69,7 +72,8 @@ def test_cnn_zoo_forward_shape(arch):
     variables = m.init({"params": jax.random.PRNGKey(0)}, x, train=False)
     out = m.apply(variables, x, train=False)
     assert out.shape == (2, 10)
-    if not arch.startswith("squeezenet"):  # squeezenet is BN-free upstream
+    # squeezenet and alexnet are BN-free upstream too
+    if not arch.startswith(("squeezenet", "alexnet")):
         assert "batch_stats" in variables  # BN plans carry running stats
 
 
@@ -90,6 +94,11 @@ TORCHVISION_PARAMS = {
     "shufflenet_v2_x2_0": 7_393_996,
     "mobilenet_v2": 3_504_872,
     "efficientnet_b0": 5_288_548,
+    "googlenet": 6_624_904,     # aux_logits=False deploy network
+    "mnasnet0_5": 2_218_512,
+    "mnasnet1_0": 4_383_312,
+    "mobilenet_v3_large": 5_483_032,
+    "mobilenet_v3_small": 2_542_856,
 }
 
 

@@ -125,30 +125,37 @@ class DenseNet(nn.Module):
 
 
 class _InvertedResidual(nn.Module):
-    """MobileNetV2 block: 1x1 expand -> 3x3 depthwise -> 1x1 project,
-    residual when stride 1 and channels match. ReLU6 activations, linear
-    bottleneck (no activation after the projection)."""
+    """Inverted residual: 1x1 expand -> kxk depthwise -> 1x1 project,
+    residual when stride 1 and channels match; linear bottleneck (no
+    activation after the projection). MobileNetV2's flavor is ReLU6 /
+    kernel 3; MnasNet reuses the block with plain ReLU and 3 or 5 kernels
+    (models.mobile)."""
 
     out_ch: int
     stride: int
     expand: int
     dtype: jnp.dtype
+    kernel: int = 3
+    act: str = "relu6"  # relu6 | relu
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        act = ((lambda h: jnp.clip(h, 0.0, 6.0)) if self.act == "relu6"
+               else nn.relu)
         in_ch = x.shape[-1]
+        k, p = self.kernel, self.kernel // 2
         h = x
         if self.expand != 1:
             h = nn.Conv(in_ch * self.expand, (1, 1), use_bias=False,
                         dtype=self.dtype, name="expand")(h)
-            h = jnp.clip(norm(name="bn_expand")(h), 0.0, 6.0)
+            h = act(norm(name="bn_expand")(h))
         ch = h.shape[-1]
-        h = nn.Conv(ch, (3, 3), (self.stride, self.stride),
-                    padding=[(1, 1), (1, 1)], feature_group_count=ch,
+        h = nn.Conv(ch, (k, k), (self.stride, self.stride),
+                    padding=[(p, p), (p, p)], feature_group_count=ch,
                     use_bias=False, dtype=self.dtype, name="depthwise")(h)
-        h = jnp.clip(norm(name="bn_dw")(h), 0.0, 6.0)
+        h = act(norm(name="bn_dw")(h))
         h = nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype,
                     name="project")(h)
         h = norm(name="bn_project")(h)
@@ -395,6 +402,45 @@ class _Fire(nn.Module):
         b = nn.relu(nn.Conv(self.e3, (3, 3), padding=[(1, 1), (1, 1)],
                             dtype=self.dtype, name="expand3")(s))
         return jnp.concatenate([a, b], axis=-1)
+
+
+class AlexNet(nn.Module):
+    """torchvision alexnet feature plan (biased convs, no BatchNorm) with
+    the same GAP-head adaptation as VGG (module docstring): the 256-ch map
+    is globally pooled into the 4096-wide FC stack instead of flattening a
+    fixed 6x6 grid, so CIFAR 32px and ImageNet 224px both run. Pools are
+    skipped when the map is smaller than the window (32px reaches 1x1
+    before the final pool)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        def pool(h):
+            return _max_pool_ceil(h) if min(h.shape[1:3]) >= 3 else h
+
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(64, (11, 11), (4, 4), padding=[(2, 2), (2, 2)],
+                            dtype=self.dtype, name="conv0")(x))
+        x = pool(x)
+        x = nn.relu(nn.Conv(192, (5, 5), padding=[(2, 2), (2, 2)],
+                            dtype=self.dtype, name="conv1")(x))
+        x = pool(x)
+        x = nn.relu(nn.Conv(384, (3, 3), padding=[(1, 1), (1, 1)],
+                            dtype=self.dtype, name="conv2")(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding=[(1, 1), (1, 1)],
+                            dtype=self.dtype, name="conv3")(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding=[(1, 1), (1, 1)],
+                            dtype=self.dtype, name="conv4")(x))
+        x = pool(x)
+        x = jnp.mean(x, axis=(1, 2))  # adaptive pool (any input size)
+        for j in range(2):
+            x = nn.Dropout(0.5, deterministic=not train,
+                           name=f"drop{j}")(x)
+            x = nn.relu(nn.Dense(4096, dtype=self.dtype, name=f"fc{j}")(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
 
 
 # torchvision fire sequences, pools marked 'M' (the VGG plan idiom):

@@ -1,0 +1,112 @@
+"""GoogLeNet (Inception v1) plan (C2 catalog breadth).
+
+torchvision's googlenet with aux_logits=False: the training-time auxiliary
+classifiers exist upstream for the original paper's vanishing-gradient
+workaround, which BatchNorm (this plan, like torchvision's) already solves —
+the deploy-time network is identical. Faithful quirk preserved: torchvision's
+"5x5" inception branch actually uses a 3x3 kernel (the long-standing upstream
+bug, kept for weight/parameter compatibility) — branch3 here does the same.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpu_dist.models.cnn_zoo import _max_pool_ceil
+
+
+class _BasicConv(nn.Module):
+    """conv (no bias) + BN(eps 1e-3, torchvision's) + relu."""
+
+    ch: int
+    kernel: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        k, p = self.kernel, self.kernel // 2
+        x = nn.Conv(self.ch, (k, k), (self.stride, self.stride),
+                    padding=[(p, p), (p, p)], use_bias=False,
+                    dtype=self.dtype, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=jnp.float32, name="bn")(x)
+        return nn.relu(x)
+
+
+class _Inception(nn.Module):
+    """Four parallel branches concatenated on channels: 1x1 / 1x1->3x3 /
+    1x1->'5x5'(really 3x3) / pool->1x1."""
+
+    ch1: int
+    ch3r: int
+    ch3: int
+    ch5r: int
+    ch5: int
+    pool_proj: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(_BasicConv, dtype=self.dtype)
+        b1 = conv(self.ch1, 1, name="b1")(x, train)
+        b2 = conv(self.ch3, 3, name="b2_3x3")(
+            conv(self.ch3r, 1, name="b2_1x1")(x, train), train)
+        b3 = conv(self.ch5, 3, name="b3_5x5")(  # 3x3 kernel: see module doc
+            conv(self.ch5r, 1, name="b3_1x1")(x, train), train)
+        b4 = conv(self.pool_proj, 1, name="b4_1x1")(
+            nn.max_pool(x, (3, 3), strides=(1, 1),
+                        padding=[(1, 1), (1, 1)]), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+# (ch1x1, ch3x3red, ch3x3, ch5x5red, ch5x5, pool_proj) per torchvision
+_PLAN = {
+    "3a": (64, 96, 128, 16, 32, 32),
+    "3b": (128, 128, 192, 32, 96, 64),
+    "4a": (192, 96, 208, 16, 48, 64),
+    "4b": (160, 112, 224, 24, 64, 64),
+    "4c": (128, 128, 256, 24, 64, 64),
+    "4d": (112, 144, 288, 32, 64, 64),
+    "4e": (256, 160, 320, 32, 128, 128),
+    "5a": (256, 160, 320, 32, 128, 128),
+    "5b": (384, 192, 384, 48, 128, 128),
+}
+
+
+class GoogLeNet(nn.Module):
+    """torchvision googlenet (aux_logits=False): 7x7/2 stem, 1x1+3x3
+    convs, nine inception blocks with ceil-mode pools between stages,
+    GAP + dropout + linear head."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(_BasicConv, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = conv(64, 7, 2, name="conv1")(x, train)
+        x = _max_pool_ceil(x)
+        x = conv(64, 1, name="conv2")(x, train)
+        x = conv(192, 3, name="conv3")(x, train)
+        x = _max_pool_ceil(x)
+        for name in ("3a", "3b"):
+            x = _Inception(*_PLAN[name], self.dtype,
+                           name=f"inception{name}")(x, train)
+        x = _max_pool_ceil(x)
+        for name in ("4a", "4b", "4c", "4d", "4e"):
+            x = _Inception(*_PLAN[name], self.dtype,
+                           name=f"inception{name}")(x, train)
+        x = _max_pool_ceil(x, k=2)
+        for name in ("5a", "5b"):
+            x = _Inception(*_PLAN[name], self.dtype,
+                           name=f"inception{name}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.2, deterministic=not train, name="drop")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)

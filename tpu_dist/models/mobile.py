@@ -1,0 +1,218 @@
+"""MnasNet and MobileNetV3 plans (C2 catalog breadth).
+
+The reference factory exposes every lowercase torchvision callable
+(reference 1.dataparallel.py:23-24); these are the NAS-derived mobile
+families rebuilt in the cnn_zoo idiom: NHWC flax, fp32 BatchNorm
+statistics over a configurable compute dtype, GAP heads.
+
+* MnasNet (torchvision mnasnet0_5/mnasnet1_0): plain-ReLU inverted
+  residuals (cnn_zoo._InvertedResidual with act='relu', kernels 3/5) whose
+  widths scale by alpha through torchvision's round-to-multiple-of-8 rule
+  (_scale_depths) — the bias-0.9 round-up is what makes the 0.5 plan's
+  widths (40 not 16, etc.) come out right.
+* MobileNetV3 (large/small): per-row block tables (expand width is given
+  absolutely, not as a ratio), squeeze-excite on exp//4 channels with
+  hardsigmoid gates, hardswish activations in the deep half, and the
+  1280/1024-wide FC head applied after pooling.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpu_dist.models.cnn_zoo import _InvertedResidual
+
+
+def _round8(val: float, round_up_bias: float = 0.9) -> int:
+    """torchvision mnasnet's _round_to_multiple_of(val, 8)."""
+    new_val = max(8, int(val + 4) // 8 * 8)
+    return new_val if new_val >= round_up_bias * val else new_val + 8
+
+
+def _scale_depths(alpha: float) -> list:
+    return [_round8(d * alpha) for d in (32, 16, 24, 40, 80, 96, 192, 320)]
+
+
+def hardsigmoid(x):
+    return jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def hardswish(x):
+    return x * hardsigmoid(x)
+
+
+class MnasNet(nn.Module):
+    """torchvision mnasnet plan: stem + sepconv + six inverted-residual
+    stacks (kernel, expansion, repeats per torchvision's table), 1280 head.
+    """
+
+    alpha: float = 1.0
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        d = _scale_depths(self.alpha)
+        x = x.astype(self.dtype)
+        x = nn.relu(norm(name="bn0")(
+            conv(d[0], (3, 3), (2, 2), padding=[(1, 1), (1, 1)],
+                 name="conv0")(x)))
+        # separable conv: depthwise 3x3 + linear 1x1 projection
+        x = nn.relu(norm(name="bn_dw")(
+            conv(d[0], (3, 3), padding=[(1, 1), (1, 1)],
+                 feature_group_count=d[0], name="sep_dw")(x)))
+        x = norm(name="bn_sep")(conv(d[1], (1, 1), name="sep_pw")(x))
+        # (kernel, expansion, repeats, first-stride) per torchvision stack
+        plan = ((3, 3, 3, 2), (5, 3, 3, 2), (5, 6, 3, 2),
+                (3, 6, 2, 1), (5, 6, 4, 2), (3, 6, 1, 1))
+        for si, (k, e, n, s) in enumerate(plan):
+            out = d[si + 2]
+            for i in range(n):
+                x = _InvertedResidual(out, s if i == 0 else 1, e, self.dtype,
+                                      kernel=k, act="relu",
+                                      name=f"stack{si}_block{i}")(x, train)
+        x = nn.relu(norm(name="bn_head")(conv(1280, (1, 1),
+                                              name="conv_head")(x)))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.2, deterministic=not train, name="drop")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+class _SqueezeExciteV3(nn.Module):
+    """MobileNetV3 SE: squeeze to round8(expanded // 4), relu, hardsigmoid
+    gate — biased 1x1 convs in the compute dtype, the same policy as
+    cnn_zoo._SqueezeExcite."""
+
+    reduce_ch: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.relu(nn.Conv(self.reduce_ch, (1, 1), dtype=self.dtype,
+                            name="fc1")(s))
+        s = hardsigmoid(nn.Conv(x.shape[-1], (1, 1), dtype=self.dtype,
+                                name="fc2")(s))
+        return x * s
+
+
+class _V3Block(nn.Module):
+    """MobileNetV3 inverted residual: expand to an ABSOLUTE width, kxk
+    depthwise, optional SE, linear projection; relu or hardswish."""
+
+    out_ch: int
+    exp_ch: int
+    kernel: int
+    stride: int
+    use_se: bool
+    act: str  # 'relu' | 'hswish'
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # torchvision mobilenet_v3 builds its BNs with eps=1e-3
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-3, dtype=jnp.float32)
+        act = nn.relu if self.act == "relu" else hardswish
+        in_ch = x.shape[-1]
+        k, p = self.kernel, self.kernel // 2
+        h = x
+        if self.exp_ch != in_ch:
+            h = nn.Conv(self.exp_ch, (1, 1), use_bias=False,
+                        dtype=self.dtype, name="expand")(h)
+            h = act(norm(name="bn_expand")(h))
+        h = nn.Conv(self.exp_ch, (k, k), (self.stride, self.stride),
+                    padding=[(p, p), (p, p)],
+                    feature_group_count=self.exp_ch, use_bias=False,
+                    dtype=self.dtype, name="depthwise")(h)
+        h = act(norm(name="bn_dw")(h))
+        if self.use_se:
+            h = _SqueezeExciteV3(_round8(self.exp_ch / 4), self.dtype,
+                                 name="se")(h)
+        h = nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="project")(h)
+        h = norm(name="bn_project")(h)
+        if self.stride == 1 and in_ch == self.out_ch:
+            h = x + h
+        return h
+
+
+# (kernel, exp, out, SE, act, stride) — torchvision's settings tables
+_V3_LARGE = (
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hswish", 2),
+    (3, 200, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1),
+    (3, 480, 112, True, "hswish", 1),
+    (3, 672, 112, True, "hswish", 1),
+    (5, 672, 160, True, "hswish", 2),
+    (5, 960, 160, True, "hswish", 1),
+    (5, 960, 160, True, "hswish", 1),
+)
+_V3_SMALL = (
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hswish", 2),
+    (5, 240, 40, True, "hswish", 1),
+    (5, 240, 40, True, "hswish", 1),
+    (5, 120, 48, True, "hswish", 1),
+    (5, 144, 48, True, "hswish", 1),
+    (5, 288, 96, True, "hswish", 2),
+    (5, 576, 96, True, "hswish", 1),
+    (5, 576, 96, True, "hswish", 1),
+)
+
+
+class MobileNetV3(nn.Module):
+    """torchvision mobilenet_v3 plan: 16-ch hardswish stem, the per-variant
+    block table, 6x-width hardswish conv, GAP, FC head (1280 large / 1024
+    small) with hardswish + dropout before the classifier."""
+
+    plan: Sequence = _V3_LARGE
+    head_width: int = 1280
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # torchvision mobilenet_v3 builds its BNs with eps=1e-3
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-3, dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        x = hardswish(norm(name="bn_stem")(
+            nn.Conv(16, (3, 3), (2, 2), padding=[(1, 1), (1, 1)],
+                    use_bias=False, dtype=self.dtype, name="stem")(x)))
+        for i, (k, e, c, se, act, s) in enumerate(self.plan):
+            x = _V3Block(c, e, k, s, se, act, self.dtype,
+                         name=f"block{i}")(x, train)
+        last_conv = 6 * x.shape[-1]
+        x = hardswish(norm(name="bn_last")(
+            nn.Conv(last_conv, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="conv_last")(x)))
+        x = jnp.mean(x, axis=(1, 2))
+        x = hardswish(nn.Dense(self.head_width, dtype=self.dtype,
+                               name="fc_head")(x))
+        x = nn.Dropout(0.2, deterministic=not train, name="drop")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+MnasNet0_5 = partial(MnasNet, alpha=0.5)
+MnasNet1_0 = partial(MnasNet, alpha=1.0)
+MobileNetV3Large = partial(MobileNetV3, plan=_V3_LARGE, head_width=1280)
+MobileNetV3Small = partial(MobileNetV3, plan=_V3_SMALL, head_width=1024)

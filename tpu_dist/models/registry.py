@@ -19,7 +19,8 @@ from typing import Callable, Dict, Tuple
 
 import jax.numpy as jnp
 
-from tpu_dist.models import cnn_zoo, lenet, moe, resnet, transformer, vit
+from tpu_dist.models import (cnn_zoo, inception, lenet, mobile, moe, resnet,
+                             transformer, vit)
 
 # name -> (constructor, kind)
 _REGISTRY: Dict[str, Tuple[Callable, str]] = {
@@ -40,7 +41,13 @@ _REGISTRY: Dict[str, Tuple[Callable, str]] = {
     "densenet161": (cnn_zoo.DenseNet161, "image"),
     "densenet169": (cnn_zoo.DenseNet169, "image"),
     "densenet201": (cnn_zoo.DenseNet201, "image"),
+    "alexnet": (cnn_zoo.AlexNet, "image"),
+    "googlenet": (inception.GoogLeNet, "image"),
+    "mnasnet0_5": (mobile.MnasNet0_5, "image"),
+    "mnasnet1_0": (mobile.MnasNet1_0, "image"),
     "mobilenet_v2": (cnn_zoo.MobileNetV2, "image"),
+    "mobilenet_v3_large": (mobile.MobileNetV3Large, "image"),
+    "mobilenet_v3_small": (mobile.MobileNetV3Small, "image"),
     "squeezenet1_0": (cnn_zoo.SqueezeNet1_0, "image"),
     "squeezenet1_1": (cnn_zoo.SqueezeNet, "image"),
     "shufflenet_v2_x0_5": (cnn_zoo.ShuffleNetV2_x0_5, "image"),
