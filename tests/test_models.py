@@ -95,8 +95,13 @@ TORCHVISION_PARAMS = {
     "mobilenet_v2": 3_504_872,
     "efficientnet_b0": 5_288_548,
     "googlenet": 6_624_904,     # aux_logits=False deploy network
+    # published 27,161,264 minus the exactly-computable aux head
+    # (768*128 + 2*128 + 128*768*25 + 2*768 + 768*1000 + 1000 = 3,326,696)
+    "inception_v3": 23_834_568,
     "mnasnet0_5": 2_218_512,
+    "mnasnet0_75": 3_170_208,
     "mnasnet1_0": 4_383_312,
+    "mnasnet1_3": 6_282_256,
     "mobilenet_v3_large": 5_483_032,
     "mobilenet_v3_small": 2_542_856,
 }
@@ -106,11 +111,22 @@ TORCHVISION_PARAMS = {
 def test_param_count_matches_published(arch):
     """Exact parameter parity with torchvision's published counts — the
     strongest no-copy plan check available in a zero-egress container."""
+    size = 299 if arch == "inception_v3" else 224  # v3's nominal input
     m = create_model(arch, num_classes=1000)
     v = jax.eval_shape(lambda: m.init({"params": jax.random.PRNGKey(0)},
-                                      jnp.zeros((1, 224, 224, 3)),
+                                      jnp.zeros((1, size, size, 3)),
                                       train=False))
     assert _param_count(v["params"]) == TORCHVISION_PARAMS[arch]
+
+
+def test_inception_v3_forward_96px():
+    """inception_v3's VALID stem needs >=75px (as upstream); 96px runs."""
+    m = create_model("inception_v3", num_classes=10)
+    v = m.init({"params": jax.random.PRNGKey(0)},
+               jnp.zeros((2, 96, 96, 3)), train=False)
+    assert m.apply(v, jnp.ones((2, 96, 96, 3)),
+                   train=False).shape == (2, 10)
+    assert "batch_stats" in v
 
 
 @pytest.mark.parametrize("arch", ["resnext50_32x4d", "wide_resnet50_2"])

@@ -21,7 +21,7 @@ idiom as tpu_dist.models.resnet:
 from __future__ import annotations
 
 from functools import partial
-from typing import Sequence
+from typing import Callable, Sequence
 
 import flax.linen as nn
 import jax
@@ -197,20 +197,24 @@ class MobileNetV2(nn.Module):
 
 
 class _SqueezeExcite(nn.Module):
-    """EfficientNet SE: global pool -> 1x1 reduce (SiLU) -> 1x1 expand
-    (sigmoid) -> scale. ``reduce_ch`` follows torchvision: the block's
-    INPUT channels // 4 (not the expanded width)."""
+    """Squeeze-excite: global pool -> 1x1 reduce (act) -> 1x1 expand (gate)
+    -> scale. EfficientNet's flavor is silu/sigmoid with ``reduce_ch`` the
+    block's INPUT channels // 4 (torchvision, not the expanded width);
+    MobileNetV3 reuses the block with relu/hard_sigmoid on round8(exp/4)
+    channels (models.mobile)."""
 
     reduce_ch: int
     dtype: jnp.dtype
+    act: Callable = nn.silu
+    gate: Callable = nn.sigmoid
 
     @nn.compact
     def __call__(self, x):
         s = jnp.mean(x, axis=(1, 2), keepdims=True)
-        s = nn.silu(nn.Conv(self.reduce_ch, (1, 1), dtype=self.dtype,
-                            name="fc1")(s))
-        s = nn.sigmoid(nn.Conv(x.shape[-1], (1, 1), dtype=self.dtype,
-                               name="fc2")(s))
+        s = self.act(nn.Conv(self.reduce_ch, (1, 1), dtype=self.dtype,
+                             name="fc1")(s))
+        s = self.gate(nn.Conv(x.shape[-1], (1, 1), dtype=self.dtype,
+                              name="fc2")(s))
         return x * s
 
 

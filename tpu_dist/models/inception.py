@@ -11,7 +11,7 @@ bug, kept for weight/parameter compatibility) — branch3 here does the same.
 from __future__ import annotations
 
 from functools import partial
-from typing import Sequence
+
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -20,18 +20,26 @@ from tpu_dist.models.cnn_zoo import _max_pool_ceil
 
 
 class _BasicConv(nn.Module):
-    """conv (no bias) + BN(eps 1e-3, torchvision's) + relu."""
+    """conv (no bias) + BN(eps 1e-3, torchvision's) + relu.
+
+    ``kernel`` is an int or (kh, kw) — inception v3's factorized 1x7/7x1
+    branches use the asymmetric form. ``pad`` 'same' centers the padding
+    (odd kernels); 'valid' is the unpadded stem/downsample flavor."""
 
     ch: int
-    kernel: int
+    kernel: int | tuple = 1
     stride: int = 1
+    pad: str = "same"
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        k, p = self.kernel, self.kernel // 2
-        x = nn.Conv(self.ch, (k, k), (self.stride, self.stride),
-                    padding=[(p, p), (p, p)], use_bias=False,
+        k = (self.kernel if isinstance(self.kernel, tuple)
+             else (self.kernel, self.kernel))
+        padding = ("VALID" if self.pad == "valid"
+                   else [(k[0] // 2, k[0] // 2), (k[1] // 2, k[1] // 2)])
+        x = nn.Conv(self.ch, k, (self.stride, self.stride),
+                    padding=padding, use_bias=False,
                     dtype=self.dtype, name="conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-3, dtype=jnp.float32, name="bn")(x)
@@ -108,5 +116,145 @@ class GoogLeNet(nn.Module):
                            name=f"inception{name}")(x, train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(0.2, deterministic=not train, name="drop")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Inception v3 (torchvision inception_v3, aux_logits=False — like GoogLeNet
+# above, the aux head is a train-time-only artifact of the pre-BN era; the
+# deploy network is identical). Minimum input ~75px (the VALID stem and two
+# stride-2 reductions shrink 32px inputs to nothing, exactly as upstream).
+
+class _InceptionA(nn.Module):
+    pool_ch: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(_BasicConv, dtype=self.dtype)
+        b1 = conv(64, name="b1")(x, train)
+        b5 = conv(64, 5, name="b5_2")(conv(48, name="b5_1")(x, train), train)
+        b3 = conv(96, 3, name="b3_3")(
+            conv(96, 3, name="b3_2")(
+                conv(64, name="b3_1")(x, train), train), train)
+        bp = conv(self.pool_ch, name="bp")(
+            nn.avg_pool(x, (3, 3), strides=(1, 1),
+                        padding=[(1, 1), (1, 1)]), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class _InceptionB(nn.Module):
+    """Grid reduction: stride-2 3x3 + double-3x3 + maxpool."""
+
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(_BasicConv, dtype=self.dtype)
+        b3 = conv(384, 3, 2, "valid", name="b3")(x, train)
+        bd = conv(96, 3, 2, "valid", name="bd_3")(
+            conv(96, 3, name="bd_2")(
+                conv(64, name="bd_1")(x, train), train), train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class _InceptionC(nn.Module):
+    """Factorized 7x7 branches at width c7."""
+
+    c7: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(_BasicConv, dtype=self.dtype)
+        c7 = self.c7
+        b1 = conv(192, name="b1")(x, train)
+        b7 = conv(192, (7, 1), name="b7_3")(
+            conv(c7, (1, 7), name="b7_2")(
+                conv(c7, name="b7_1")(x, train), train), train)
+        h = conv(c7, name="bd_1")(x, train)
+        for i, k in enumerate(((7, 1), (1, 7), (7, 1))):
+            h = conv(c7, k, name=f"bd_{i + 2}")(h, train)
+        bd = conv(192, (1, 7), name="bd_5")(h, train)
+        bp = conv(192, name="bp")(
+            nn.avg_pool(x, (3, 3), strides=(1, 1),
+                        padding=[(1, 1), (1, 1)]), train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class _InceptionD(nn.Module):
+    """Grid reduction: 1x1->3x3/2 + 1x1->1x7->7x1->3x3/2 + maxpool."""
+
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(_BasicConv, dtype=self.dtype)
+        b3 = conv(320, 3, 2, "valid", name="b3_2")(
+            conv(192, name="b3_1")(x, train), train)
+        h = conv(192, name="b7_1")(x, train)
+        h = conv(192, (1, 7), name="b7_2")(h, train)
+        h = conv(192, (7, 1), name="b7_3")(h, train)
+        b7 = conv(192, 3, 2, "valid", name="b7_4")(h, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class _InceptionE(nn.Module):
+    """Expanded-filter-bank block: 1x3/3x1 splits concatenated."""
+
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(_BasicConv, dtype=self.dtype)
+        b1 = conv(320, name="b1")(x, train)
+        h = conv(384, name="b3_1")(x, train)
+        b3 = jnp.concatenate(
+            [conv(384, (1, 3), name="b3_2a")(h, train),
+             conv(384, (3, 1), name="b3_2b")(h, train)], axis=-1)
+        h = conv(384, 3, name="bd_2")(conv(448, name="bd_1")(x, train), train)
+        bd = jnp.concatenate(
+            [conv(384, (1, 3), name="bd_3a")(h, train),
+             conv(384, (3, 1), name="bd_3b")(h, train)], axis=-1)
+        bp = conv(192, name="bp")(
+            nn.avg_pool(x, (3, 3), strides=(1, 1),
+                        padding=[(1, 1), (1, 1)]), train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """torchvision inception_v3 (aux_logits=False): VALID-conv stem to
+    192ch, 3xA (pool 32/64/64), B, 4xC (c7 128/160/160/192), D, 2xE,
+    GAP + dropout + linear head."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(_BasicConv, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = conv(32, 3, 2, "valid", name="stem1a")(x, train)
+        x = conv(32, 3, pad="valid", name="stem2a")(x, train)
+        x = conv(64, 3, name="stem2b")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = conv(80, name="stem3b")(x, train)
+        x = conv(192, 3, pad="valid", name="stem4a")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        for i, pool_ch in enumerate((32, 64, 64)):
+            x = _InceptionA(pool_ch, self.dtype, name=f"mixed5{'bcd'[i]}")(
+                x, train)
+        x = _InceptionB(self.dtype, name="mixed6a")(x, train)
+        for i, c7 in enumerate((128, 160, 160, 192)):
+            x = _InceptionC(c7, self.dtype, name=f"mixed6{'bcde'[i]}")(
+                x, train)
+        x = _InceptionD(self.dtype, name="mixed7a")(x, train)
+        for i in range(2):
+            x = _InceptionE(self.dtype, name=f"mixed7{'bc'[i]}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train, name="drop")(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
         return x.astype(jnp.float32)

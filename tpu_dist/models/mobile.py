@@ -24,7 +24,7 @@ from typing import Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
-from tpu_dist.models.cnn_zoo import _InvertedResidual
+from tpu_dist.models.cnn_zoo import _InvertedResidual, _SqueezeExcite
 
 
 def _round8(val: float, round_up_bias: float = 0.9) -> int:
@@ -35,14 +35,6 @@ def _round8(val: float, round_up_bias: float = 0.9) -> int:
 
 def _scale_depths(alpha: float) -> list:
     return [_round8(d * alpha) for d in (32, 16, 24, 40, 80, 96, 192, 320)]
-
-
-def hardsigmoid(x):
-    return jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
-
-
-def hardswish(x):
-    return x * hardsigmoid(x)
 
 
 class MnasNet(nn.Module):
@@ -86,24 +78,6 @@ class MnasNet(nn.Module):
         return x.astype(jnp.float32)
 
 
-class _SqueezeExciteV3(nn.Module):
-    """MobileNetV3 SE: squeeze to round8(expanded // 4), relu, hardsigmoid
-    gate — biased 1x1 convs in the compute dtype, the same policy as
-    cnn_zoo._SqueezeExcite."""
-
-    reduce_ch: int
-    dtype: jnp.dtype
-
-    @nn.compact
-    def __call__(self, x):
-        s = jnp.mean(x, axis=(1, 2), keepdims=True)
-        s = nn.relu(nn.Conv(self.reduce_ch, (1, 1), dtype=self.dtype,
-                            name="fc1")(s))
-        s = hardsigmoid(nn.Conv(x.shape[-1], (1, 1), dtype=self.dtype,
-                                name="fc2")(s))
-        return x * s
-
-
 class _V3Block(nn.Module):
     """MobileNetV3 inverted residual: expand to an ABSOLUTE width, kxk
     depthwise, optional SE, linear projection; relu or hardswish."""
@@ -121,7 +95,7 @@ class _V3Block(nn.Module):
         # torchvision mobilenet_v3 builds its BNs with eps=1e-3
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-3, dtype=jnp.float32)
-        act = nn.relu if self.act == "relu" else hardswish
+        act = nn.relu if self.act == "relu" else nn.hard_swish
         in_ch = x.shape[-1]
         k, p = self.kernel, self.kernel // 2
         h = x
@@ -135,8 +109,9 @@ class _V3Block(nn.Module):
                     dtype=self.dtype, name="depthwise")(h)
         h = act(norm(name="bn_dw")(h))
         if self.use_se:
-            h = _SqueezeExciteV3(_round8(self.exp_ch / 4), self.dtype,
-                                 name="se")(h)
+            h = _SqueezeExcite(_round8(self.exp_ch / 4), self.dtype,
+                               act=nn.relu, gate=nn.hard_sigmoid,
+                               name="se")(h)
         h = nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype,
                     name="project")(h)
         h = norm(name="bn_project")(h)
@@ -194,18 +169,18 @@ class MobileNetV3(nn.Module):
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-3, dtype=jnp.float32)
         x = x.astype(self.dtype)
-        x = hardswish(norm(name="bn_stem")(
+        x = nn.hard_swish(norm(name="bn_stem")(
             nn.Conv(16, (3, 3), (2, 2), padding=[(1, 1), (1, 1)],
                     use_bias=False, dtype=self.dtype, name="stem")(x)))
         for i, (k, e, c, se, act, s) in enumerate(self.plan):
             x = _V3Block(c, e, k, s, se, act, self.dtype,
                          name=f"block{i}")(x, train)
         last_conv = 6 * x.shape[-1]
-        x = hardswish(norm(name="bn_last")(
+        x = nn.hard_swish(norm(name="bn_last")(
             nn.Conv(last_conv, (1, 1), use_bias=False, dtype=self.dtype,
                     name="conv_last")(x)))
         x = jnp.mean(x, axis=(1, 2))
-        x = hardswish(nn.Dense(self.head_width, dtype=self.dtype,
+        x = nn.hard_swish(nn.Dense(self.head_width, dtype=self.dtype,
                                name="fc_head")(x))
         x = nn.Dropout(0.2, deterministic=not train, name="drop")(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
@@ -213,6 +188,8 @@ class MobileNetV3(nn.Module):
 
 
 MnasNet0_5 = partial(MnasNet, alpha=0.5)
+MnasNet0_75 = partial(MnasNet, alpha=0.75)
 MnasNet1_0 = partial(MnasNet, alpha=1.0)
+MnasNet1_3 = partial(MnasNet, alpha=1.3)
 MobileNetV3Large = partial(MobileNetV3, plan=_V3_LARGE, head_width=1280)
 MobileNetV3Small = partial(MobileNetV3, plan=_V3_SMALL, head_width=1024)

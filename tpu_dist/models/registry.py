@@ -43,8 +43,11 @@ _REGISTRY: Dict[str, Tuple[Callable, str]] = {
     "densenet201": (cnn_zoo.DenseNet201, "image"),
     "alexnet": (cnn_zoo.AlexNet, "image"),
     "googlenet": (inception.GoogLeNet, "image"),
+    "inception_v3": (inception.InceptionV3, "image"),
     "mnasnet0_5": (mobile.MnasNet0_5, "image"),
+    "mnasnet0_75": (mobile.MnasNet0_75, "image"),
     "mnasnet1_0": (mobile.MnasNet1_0, "image"),
+    "mnasnet1_3": (mobile.MnasNet1_3, "image"),
     "mobilenet_v2": (cnn_zoo.MobileNetV2, "image"),
     "mobilenet_v3_large": (mobile.MobileNetV3Large, "image"),
     "mobilenet_v3_small": (mobile.MobileNetV3Small, "image"),
