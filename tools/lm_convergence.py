@@ -40,6 +40,7 @@ def main():
     ap.add_argument("--num-heads", type=int, default=4)
     ap.add_argument("--synth-tokens", type=int, default=500_000)
     ap.add_argument("--lr", type=float, default=3e-2)
+    ap.add_argument("--optimizer", default="sgd", choices=["sgd", "adamw"])
     ap.add_argument("--lr-schedule", default="constant",
                     choices=["constant", "cosine", "step"])
     ap.add_argument("--warmup-steps", type=int, default=0)
@@ -72,6 +73,7 @@ def main():
         vocab_size=args.vocab_size, d_model=args.d_model,
         num_layers=args.num_layers, num_heads=args.num_heads,
         synth_tokens=args.synth_tokens, lr=args.lr, seed=args.seed,
+        optimizer=args.optimizer,
         lr_schedule=args.lr_schedule, warmup_steps=args.warmup_steps,
         lr_decay_steps=args.lr_decay_steps, lr_min_frac=args.lr_min_frac,
         precision=args.precision, attn=args.attn,
