@@ -1,6 +1,7 @@
 """Native batch-gather library (csrc/gather.cpp) vs numpy fallback."""
 
 import numpy as np
+import pytest
 
 from tpu_dist import _native
 
@@ -27,3 +28,83 @@ def test_gather_noncontiguous_falls_back():
     gi, gl = _native.gather_batch(images, labels, idx)
     np.testing.assert_array_equal(gl, labels[idx])
     assert gi.shape == (2, 2, 4, 3)
+
+
+def _jpeg_bytes(h, w, smooth=True, quality=95):
+    import io
+    from PIL import Image
+    if smooth:
+        yy, xx = np.mgrid[0:h, 0:w]
+        arr = np.stack([(xx * 255 // max(w, 1)), (yy * 255 // max(h, 1)),
+                        ((xx + yy) * 255 // (h + w))], -1).astype(np.uint8)
+    else:
+        arr = np.random.default_rng(0).integers(0, 255, (h, w, 3), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=quality)
+    return buf.getvalue(), arr
+
+
+def test_decode_jpeg_matches_pil_framing():
+    """Native decode (csrc/decode.cpp) frames identically to the PIL path
+    (short side -> size*256//224, center crop) and agrees within ~1 gray
+    level on a smooth image (resampling kernels differ by design)."""
+    pytest.importorskip("PIL")
+    if not _native.decode_available():
+        pytest.skip("built without libjpeg")
+    from PIL import Image
+    import io
+    data, _ = _jpeg_bytes(375, 500)
+    out = _native.decode_jpeg(data, 224)
+    assert out is not None and out.shape == (224, 224, 3)
+
+    im = Image.open(io.BytesIO(data)).convert("RGB")
+    w, h = im.size
+    scale = 256 / min(w, h)
+    im = im.resize((max(1, round(w * scale)), max(1, round(h * scale))))
+    ref = np.asarray(im, np.uint8)
+    top = (ref.shape[0] - 224) // 2
+    left = (ref.shape[1] - 224) // 2
+    ref = ref[top:top + 224, left:left + 224]
+    diff = np.abs(out.astype(int) - ref.astype(int))
+    assert diff.mean() < 1.0 and np.percentile(diff, 99) <= 3
+
+
+def test_decode_jpeg_dct_scaled_large_source():
+    """A source >2x the target exercises the DCT-scaling branch; output is
+    still framed and smooth-close to the PIL reference."""
+    pytest.importorskip("PIL")
+    if not _native.decode_available():
+        pytest.skip("built without libjpeg")
+    data, _ = _jpeg_bytes(1200, 1600)
+    out = _native.decode_jpeg(data, 224)
+    assert out is not None and out.shape == (224, 224, 3)
+    assert int(out.max()) > 100  # pixels actually landed
+
+
+def test_decode_jpeg_garbage_returns_none():
+    if not _native.decode_available():
+        pytest.skip("built without libjpeg")
+    assert _native.decode_jpeg(b"not a jpeg at all", 224) is None
+
+
+def test_imagefolder_native_and_pil_agree(tmp_path):
+    """The ImageFolder batch is framing-identical under both decoders."""
+    pytest.importorskip("PIL")
+    if not _native.decode_available():
+        pytest.skip("built without libjpeg")
+    from PIL import Image
+    from tpu_dist.data.imagefolder import ImageFolderDataset
+    split = tmp_path / "train" / "class0"
+    split.mkdir(parents=True)
+    for i in range(4):
+        data, _ = _jpeg_bytes(300 + 10 * i, 400)
+        (split / f"img{i}.jpg").write_bytes(data)
+    ds = ImageFolderDataset(str(tmp_path / "train"), size=224, workers=2)
+    idx = np.arange(4)
+    native_imgs, labels = ds.get_batch(idx)
+    with _native.numpy_fallback():
+        pil_imgs, labels2 = ds.get_batch(idx)
+    assert native_imgs.shape == pil_imgs.shape == (4, 224, 224, 3)
+    np.testing.assert_array_equal(labels, labels2)
+    diff = np.abs(native_imgs.astype(int) - pil_imgs.astype(int))
+    assert diff.mean() < 2.0

@@ -23,7 +23,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _make_synthetic_imagefolder(root: str, n_images: int, size: int) -> str:
-    """root/train/<class>/<img>.jpg with random pixels; returns split dir."""
+    """root/train/<class>/<img>.jpg with random pixels at the SOURCE size
+    (realistic ImageNet photos are ~500px, decoded down to the model size);
+    returns split dir."""
     import numpy as np
 
     try:
@@ -65,6 +67,8 @@ def main():
     ap.add_argument("--root", default="/tmp/tpu_dist_synth_imagefolder")
     ap.add_argument("--images", type=int, default=256)
     ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--src-size", type=int, default=500,
+                    help="stored JPEG size (ImageNet photos average ~500px)")
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--workers", type=int, default=8)
     ap.add_argument("--seconds", type=float, default=3.0)
@@ -91,19 +95,35 @@ def main():
         print("native gather library unavailable (no toolchain?)",
               file=sys.stderr)
 
-    split = _make_synthetic_imagefolder(args.root, args.images, args.size)
+    split = _make_synthetic_imagefolder(
+        args.root + f"_{args.src_size}", args.images, args.src_size)
     folder = ImageFolderDataset(split, size=args.size, workers=args.workers)
-    dec_rate = _rate(folder, args.batch, args.seconds)
-    print(f"ImageFolder JPEG decode ({args.workers} workers): "
-          f"{dec_rate:,.0f} img/s", file=sys.stderr)
+    # PIL path first (numpy_fallback also disables native decode), then the
+    # native libjpeg decoder (csrc/decode.cpp)
+    with _native.numpy_fallback():
+        pil_rate = _rate(folder, args.batch, args.seconds)
+    print(f"ImageFolder JPEG decode, PIL ({args.workers} workers, "
+          f"{args.src_size}px -> {args.size}px): {pil_rate:,.0f} img/s",
+          file=sys.stderr)
+    dec_rate = None
+    if _native.decode_available():
+        dec_rate = _rate(folder, args.batch, args.seconds)
+        print(f"ImageFolder JPEG decode, native libjpeg ({args.workers} "
+              f"workers): {dec_rate:,.0f} img/s", file=sys.stderr)
+    else:
+        print("native decode unavailable (no libjpeg at build time)",
+              file=sys.stderr)
 
     print(json.dumps({
         "metric": "host_data_path_images_per_sec",
         "array_gather_native": (round(arr_rate, 1)
                                 if arr_rate is not None else None),
         "array_gather_numpy": round(numpy_rate, 1),
-        "imagefolder_decode": round(dec_rate, 1),
+        "imagefolder_decode_pil": round(pil_rate, 1),
+        "imagefolder_decode_native": (round(dec_rate, 1)
+                                      if dec_rate is not None else None),
         "batch": args.batch, "image_size": args.size,
+        "src_size": args.src_size,
         "workers": args.workers,
         "device_rate_note": "ResNet-50 @224px device rate ~2031 img/s/chip "
                             "(BASELINE.md); decode below that means the host "

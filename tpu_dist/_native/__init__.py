@@ -21,12 +21,25 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+def _stale() -> bool:
+    """True when the .so is missing or older than any csrc/ source — a
+    stale binary would dlopen but lack newer entry points."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    built = os.path.getmtime(_LIB_PATH)
+    for fn in os.listdir(_CSRC):
+        if fn.endswith((".cpp", ".h")) or fn == "Makefile":
+            if os.path.getmtime(os.path.join(_CSRC, fn)) > built:
+                return True
+    return False
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_LIB_PATH) and os.path.isdir(_CSRC):
+    if os.path.isdir(_CSRC) and _stale():
         # cross-process build lock: spawned ranks / multi-host shared FS must
         # not run `make` concurrently onto the same .so (a reader could dlopen
         # a half-written ELF and silently pin itself to the numpy fallback)
@@ -35,11 +48,12 @@ def _load() -> Optional[ctypes.CDLL]:
         try:
             with open(lock_path, "w") as lock:
                 fcntl.flock(lock, fcntl.LOCK_EX)
-                if not os.path.exists(_LIB_PATH):  # re-check under the lock
-                    subprocess.run(["make", "-C", _CSRC], check=True,
+                if _stale():  # re-check under the lock
+                    subprocess.run(["make", "-C", _CSRC, "-B"], check=True,
                                    capture_output=True, timeout=120)
         except Exception:
-            return None
+            if not os.path.exists(_LIB_PATH):
+                return None  # no binary at all; else try the stale one
     if os.path.exists(_LIB_PATH):
         try:
             lib = ctypes.CDLL(_LIB_PATH)
@@ -49,8 +63,18 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.gather_i32.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_int64]
+            try:
+                # newer entry points bound separately: a stale .so (no
+                # toolchain to rebuild) must keep its working gather path
+                lib.decode_available.restype = ctypes.c_int
+                lib.decode_jpeg_resize_crop.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+                    ctypes.c_int, ctypes.c_void_p]
+                lib.decode_jpeg_resize_crop.restype = ctypes.c_int
+            except AttributeError:
+                pass
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
             _lib = None
     return _lib
 
@@ -101,3 +125,30 @@ def gather_batch(images: np.ndarray, labels: np.ndarray,
     out_lab = np.empty((n,), np.int32)
     lib.gather_i32(lab.ctypes.data, idx.ctypes.data, out_lab.ctypes.data, n)
     return out_imgs, out_lab
+
+
+def decode_available() -> bool:
+    """True when the library was built against libjpeg (csrc/decode.cpp).
+    False for missing library, stale pre-decode .so, or no-libjpeg build."""
+    lib = _load()
+    fn = getattr(lib, "decode_available", None) if lib is not None else None
+    return bool(fn and fn())
+
+
+def decode_jpeg(data: bytes, size: int) -> Optional[np.ndarray]:
+    """JPEG bytes -> (size, size, 3) RGB u8 via the native decoder, or None.
+
+    Native path = libjpeg DCT-scaled decode + bilinear short-side resize to
+    size*256//224 + center crop — the same framing as the PIL fallback in
+    tpu_dist.data.imagefolder._decode (resampling kernels differ). Returns
+    None (caller falls back to PIL) when the library/libjpeg is missing or
+    the bytes fail to decode.
+    """
+    if not decode_available():
+        return None
+    lib = _load()
+    out = np.empty((size, size, 3), np.uint8)
+    pre_short = size * 256 // 224
+    rc = lib.decode_jpeg_resize_crop(data, len(data), size, pre_short,
+                                     out.ctypes.data)
+    return out if rc == 0 else None

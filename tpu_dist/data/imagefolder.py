@@ -58,6 +58,18 @@ class ImageFolderDataset:
     def _decode(self, idx: int) -> np.ndarray:
         from PIL import Image
         path, _ = self.samples[idx]
+        from tpu_dist import _native
+        if (path.lower().endswith((".jpg", ".jpeg"))
+                and _native.decode_available()):  # gate BEFORE reading the
+            # file — a host without the native decoder must not pay a full
+            # read just to learn it, then read again for PIL
+            # native libjpeg path (csrc/decode.cpp): DCT-scaled decode +
+            # bilinear + center crop, GIL released for the whole call so
+            # the pool's threads decode in parallel; None -> PIL fallback
+            with open(path, "rb") as f:
+                out = _native.decode_jpeg(f.read(), self.size)
+            if out is not None:
+                return out
         with Image.open(path) as im:
             im = im.convert("RGB")
             # resize shorter side to size*1.14 then center crop (device handles
