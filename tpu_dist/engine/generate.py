@@ -17,6 +17,7 @@ sample from the model they trained. TPU-first constraints shape the design:
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Optional
 
 import jax
@@ -77,38 +78,56 @@ def generate(model, params, prompt: jax.Array, steps: int,
                                jnp.zeros((b, total), jnp.int32), train=False,
                                decode=True))["cache"]
         cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
-
-        @jax.jit
-        def decode(params, cache, buf, rng):
-            def tick(carry, pos):
-                buf, cache, rng = carry
-                tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
-                logits, muts = model.apply(
-                    {"params": params, "cache": cache}, tok, train=False,
-                    pos_offset=pos, decode=True, mutable=["cache"])
-                # consume rng ONLY on generating ticks, so the sample
-                # stream matches the full-recompute path exactly
-                generating = pos + 1 >= p
-                if temperature > 0.0:
-                    nxt, rng = jax.lax.cond(
-                        generating,
-                        lambda r: _sample(logits[:, 0], temperature, r,
-                                          top_k, top_p),
-                        lambda r: (jnp.zeros((b,), jnp.int32), r), rng)
-                else:
-                    nxt = jnp.argmax(logits[:, 0], axis=-1)
-                cur = jax.lax.dynamic_slice(buf, (0, pos + 1), (b, 1))[:, 0]
-                tok_next = jnp.where(generating, nxt.astype(jnp.int32), cur)
-                buf = jax.lax.dynamic_update_slice(
-                    buf, tok_next[:, None], (0, pos + 1))
-                return (buf, muts["cache"], rng), None
-
-            (buf, _, _), _ = jax.lax.scan(
-                tick, (buf, cache, rng), jnp.arange(0, total - 1))
-            return buf
-
+        decode = _cache_decode_program(model, b, p, total, temperature,
+                                       top_k, top_p)
         return decode(params, cache, buf, rng)
 
+    decode = _full_decode_program(model, b, p, total, temperature,
+                                  top_k, top_p)
+    return decode(params, buf, rng)
+
+
+# The compiled programs are memoized per (model, geometry, sampling)
+# signature: a fresh `jax.jit` closure per generate() call would make EVERY
+# call retrace and recompile (jit caches by function identity) — measured at
+# ~13 ms/token vs the 0.7 ms/token the compiled tick actually costs.
+
+@lru_cache(maxsize=32)
+def _cache_decode_program(model, b, p, total, temperature, top_k, top_p):
+    @jax.jit
+    def decode(params, cache, buf, rng):
+        def tick(carry, pos):
+            buf, cache, rng = carry
+            tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
+            logits, muts = model.apply(
+                {"params": params, "cache": cache}, tok, train=False,
+                pos_offset=pos, decode=True, mutable=["cache"])
+            # consume rng ONLY on generating ticks, so the sample
+            # stream matches the full-recompute path exactly
+            generating = pos + 1 >= p
+            if temperature > 0.0:
+                nxt, rng = jax.lax.cond(
+                    generating,
+                    lambda r: _sample(logits[:, 0], temperature, r,
+                                      top_k, top_p),
+                    lambda r: (jnp.zeros((b,), jnp.int32), r), rng)
+            else:
+                nxt = jnp.argmax(logits[:, 0], axis=-1)
+            cur = jax.lax.dynamic_slice(buf, (0, pos + 1), (b, 1))[:, 0]
+            tok_next = jnp.where(generating, nxt.astype(jnp.int32), cur)
+            buf = jax.lax.dynamic_update_slice(
+                buf, tok_next[:, None], (0, pos + 1))
+            return (buf, muts["cache"], rng), None
+
+        (buf, _, _), _ = jax.lax.scan(
+            tick, (buf, cache, rng), jnp.arange(0, total - 1))
+        return buf
+
+    return decode
+
+
+@lru_cache(maxsize=32)
+def _full_decode_program(model, b, p, total, temperature, top_k, top_p):
     @jax.jit
     def decode(params, buf, rng):
         def tick(carry, pos):
@@ -126,4 +145,4 @@ def generate(model, params, prompt: jax.Array, steps: int,
             tick, (buf, rng), jnp.arange(p - 1, total - 1))
         return buf
 
-    return decode(params, buf, rng)
+    return decode
