@@ -64,9 +64,9 @@ def main():
         # completion forced with a device_get readback — block_until_ready
         # does not reliably block across tunneled controllers (same caveat
         # as bench.py); the readback is (B, total) i32, microseconds.
-        # ticks: the cache path prefills the prompt one token per tick, so
-        # its scan runs total-1 ticks; the full path runs exactly `steps`.
-        ticks = (total - 1) if use_cache else args.steps
+        # ticks: the cache path runs ONE batched prefill forward + steps-1
+        # one-token ticks; the full path runs exactly `steps` full forwards.
+        ticks = args.steps
         out = generate(model, params, prompt, args.steps,
                        temperature=args.temperature, use_cache=use_cache)
         jax.device_get(out)                             # compile + warm
@@ -82,9 +82,9 @@ def main():
 
     cache_rate, cache_ms, out_c = timed(True)
     print(f"kv-cache decode: {cache_rate:,.0f} generated-tok/s incl. "
-          f"prefill ({cache_ms:.2f} ms/tick over {total - 1} ticks, "
+          f"batched prefill ({cache_ms:.2f} ms/generated token, "
           f"batch {args.batch}, {args.num_layers}L/d{args.d_model}, "
-          f"total {total})", file=sys.stderr)
+          f"prompt {args.prompt_len}, total {total})", file=sys.stderr)
     full_rate = None
     if not args.skip_full:
         full_rate, full_ms, out_f = timed(False)

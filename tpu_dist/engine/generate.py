@@ -102,31 +102,41 @@ def _cache_shapes(model, b, total):
 def _cache_decode_program(model, b, p, total, temperature, top_k, top_p):
     @jax.jit
     def decode(params, cache, buf, rng):
+        # prefill: ONE forward over the whole prompt writes cache[0:p)
+        # (the per-block dynamic_update_slice handles a (B, P, ...) write)
+        # and its last position's logits sample the first generated token —
+        # P times fewer ticks than feeding the prompt one token at a time
+        prompt = jax.lax.dynamic_slice(buf, (0, 0), (b, p))
+        logits, muts = model.apply(
+            {"params": params, "cache": cache}, prompt, train=False,
+            pos_offset=0, decode=True, mutable=["cache"])
+        cache = muts["cache"]
+        if temperature > 0.0:
+            nxt, rng = _sample(logits[:, -1], temperature, rng, top_k, top_p)
+        else:
+            nxt = jnp.argmax(logits[:, -1], axis=-1)
+        buf = jax.lax.dynamic_update_slice(
+            buf, nxt[:, None].astype(jnp.int32), (0, p))
+
         def tick(carry, pos):
             buf, cache, rng = carry
             tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
             logits, muts = model.apply(
                 {"params": params, "cache": cache}, tok, train=False,
                 pos_offset=pos, decode=True, mutable=["cache"])
-            # consume rng ONLY on generating ticks, so the sample
-            # stream matches the full-recompute path exactly
-            generating = pos + 1 >= p
+            # rng splits once per generated token, in generation order —
+            # the same stream as the full-recompute path
             if temperature > 0.0:
-                nxt, rng = jax.lax.cond(
-                    generating,
-                    lambda r: _sample(logits[:, 0], temperature, r,
-                                      top_k, top_p),
-                    lambda r: (jnp.zeros((b,), jnp.int32), r), rng)
+                nxt, rng = _sample(logits[:, 0], temperature, rng,
+                                   top_k, top_p)
             else:
                 nxt = jnp.argmax(logits[:, 0], axis=-1)
-            cur = jax.lax.dynamic_slice(buf, (0, pos + 1), (b, 1))[:, 0]
-            tok_next = jnp.where(generating, nxt.astype(jnp.int32), cur)
             buf = jax.lax.dynamic_update_slice(
-                buf, tok_next[:, None], (0, pos + 1))
+                buf, nxt[:, None].astype(jnp.int32), (0, pos + 1))
             return (buf, muts["cache"], rng), None
 
         (buf, _, _), _ = jax.lax.scan(
-            tick, (buf, cache, rng), jnp.arange(0, total - 1))
+            tick, (buf, cache, rng), jnp.arange(p, total - 1))
         return buf
 
     return decode
