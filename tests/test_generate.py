@@ -124,3 +124,14 @@ def test_top_p_nucleus_keeps_valid_tokens():
     out = generate(lm, params, prompt, steps=6, temperature=1.0, top_p=0.9,
                    rng=jax.random.PRNGKey(4), use_cache=True)
     assert int(jnp.min(out)) >= 0 and int(jnp.max(out)) < V
+
+
+def test_generate_zero_steps_returns_prompt():
+    """steps=0 is a no-op in BOTH paths (the cache prefill must not clamp
+    its first-token write into the last prompt column)."""
+    import jax.numpy as jnp
+    model, params = _lm_and_params()
+    prompt = jnp.arange(12, dtype=jnp.int32).reshape(2, 6) % 7
+    for use_cache in (False, True):
+        out = generate(model, params, prompt, 0, use_cache=use_cache)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))

@@ -65,6 +65,11 @@ def generate(model, params, prompt: jax.Array, steps: int,
     dense TransformerLM; MoE models use the default full-recompute path).
     """
     b, p = prompt.shape
+    if steps <= 0:
+        # nothing to generate: return the prompt untouched (the cache
+        # path's prefill would otherwise clamp its first-token write into
+        # the last prompt column, and burn an rng split)
+        return prompt
     total = p + steps
     if rng is None:
         rng = jax.random.PRNGKey(0)
