@@ -359,3 +359,75 @@ def test_moe_training_reports_router_mass(tmp_path):
     metrics = tr.train_epoch(0)
     assert "rmass" in metrics
     assert 0.0 < metrics["rmass"] <= 1.0 + 1e-5
+
+
+def test_moe_sp_composition_matches_dp():
+    """MoE + sequence parallelism (round 4): with a router group size that
+    divides the shard's tokens, sp grouping partitions each row into the
+    SAME contiguous segments as the dp grouping, so one sp train step
+    (aux_weight=0 — the balance loss averages differently across shards)
+    equals one dp step parameter-for-parameter."""
+    from functools import partial
+
+    from tpu_dist.engine.lm_steps import make_lm_sp_train_step
+
+    rng_np = np.random.default_rng(3)
+    tokens = rng_np.integers(0, V, (8, L + 1)).astype(np.int32)
+    inputs, targets = make_lm_batches(tokens)
+    # sp shard per device: (8/2) x (32/4) = 32 tokens; group 8 divides the
+    # shard AND each row's 8-token segments, matching dp's row-major
+    # (B*L)/8 grouping segment for segment
+    ctor = partial(MoETransformerLM, vocab_size=V, max_len=L,
+                   num_experts=E, group_size=8)
+    model = ctor()
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, L), jnp.int32), train=False)["params"]
+    tx = make_optimizer(0.05, 0.9, 0.0, steps_per_epoch=1000)
+    key = jax.random.PRNGKey(7)
+
+    mesh_dp = make_mesh((8,), ("data",))
+    st = jax.device_put(TrainState.create(params, {}, tx),
+                        replicated(mesh_dp))
+    dp_step = make_lm_train_step(model, tx, mesh_dp, aux_weight=0.0,
+                                 donate=False)
+    sh = NamedSharding(mesh_dp, P("data"))
+    st_dp, _ = dp_step(st, jax.device_put(inputs, sh),
+                       jax.device_put(targets, sh), key)
+
+    mesh_sp = make_mesh((2, 4), ("data", "seq"))
+    st2 = jax.device_put(TrainState.create(params, {}, tx),
+                         replicated(mesh_sp))
+    sp_step = make_lm_sp_train_step(ctor, tx, mesh_sp, aux_weight=0.0,
+                                    donate=False)
+    sh_sp = NamedSharding(mesh_sp, P("data", "seq"))
+    st_sp, _ = sp_step(st2, jax.device_put(inputs, sh_sp),
+                       jax.device_put(targets, sh_sp), key)
+
+    flat_dp = {jax.tree_util.keystr(p): np.asarray(v) for p, v in
+               jax.tree_util.tree_flatten_with_path(
+                   jax.device_get(st_dp.params))[0]}
+    flat_sp = {jax.tree_util.keystr(p): np.asarray(v) for p, v in
+               jax.tree_util.tree_flatten_with_path(
+                   jax.device_get(st_sp.params))[0]}
+    assert flat_dp.keys() == flat_sp.keys()
+    for k in flat_dp:
+        np.testing.assert_allclose(flat_sp[k], flat_dp[k],
+                                   rtol=2e-4, atol=1e-5, err_msg=k)
+
+
+def test_moe_sp_trains_via_lm_trainer():
+    """LMTrainer accepts data=2,seq=4 + --num-experts (the round-3 'not
+    supported yet' rejection is gone) and trains + evaluates end to end."""
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    cfg = LMConfig(mesh_shape=(2, 4), mesh_axes=("data", "seq"),
+                   num_experts=4, moe_group_size=8, batch_size=8,
+                   seq_len=32, d_model=32, num_layers=2, num_heads=2,
+                   vocab_size=64, synth_tokens=3000, seed=3, epochs=2,
+                   optimizer="adamw", lr=3e-3, print_freq=100,
+                   data_placement="host")
+    tr = LMTrainer(cfg)
+    tr.fit()
+    loss, ppl, acc = tr.validate()
+    assert np.isfinite(loss) and ppl < 64  # better than uniform

@@ -123,6 +123,10 @@ class LMConfig:
     num_heads: int = 8
     num_experts: int = 0           # MoE feed-forward with N experts (0=dense)
     router_top_k: int = 1          # 1 = Switch top-1, 2 = GShard top-2
+    moe_group_size: int = 512      # router group tokens (GShard grouping;
+                                   # under sp, groups are shard-local — a
+                                   # size dividing the shard keeps routing
+                                   # identical to the dp grouping)
     attn: str = "full"             # full | blockwise | flash (Pallas FA2)
     attn_block: int = 1024         # KV block for blockwise/flash (clamped
                                    # to seq_len; 1024 measured ~20% faster

@@ -284,8 +284,10 @@ class LMTrainer:
                              "(GPipe over dense TransformerLM blocks)")
         if self.use_ep and not cfg.num_experts:
             raise ValueError("an 'expert' mesh axis requires num_experts > 0")
-        if self.use_sp and cfg.num_experts:
-            raise ValueError("MoE + sequence parallelism not supported yet")
+        # (MoE composes with a 'seq' axis: experts are replicated and the
+        # GShard dispatch is group-local math, so it runs unchanged inside
+        # the sp shard_map — router groups become shard-local; a
+        # --moe-group-size dividing the shard keeps routing dp-identical)
         if self.use_tp and cfg.num_experts and not self.use_ep:
             raise ValueError("MoE + pure tensor parallelism not supported: "
                              "use data=N,expert=M[,model=K]")
@@ -317,8 +319,12 @@ class LMTrainer:
                      attn_fn=attn_fn, remat=cfg.remat)
         if cfg.num_experts:
             from tpu_dist.models.moe import MoETransformerLM
-            model = MoETransformerLM(num_experts=cfg.num_experts,
-                                     router_top_k=cfg.router_top_k, **lm_kw)
+            # the MoE knobs ride in the ctor kwargs so EVERY mode (jit, sp
+            # rebind, windowed) builds the identical model from ONE dict
+            lm_kw = dict(lm_kw, num_experts=cfg.num_experts,
+                         router_top_k=cfg.router_top_k,
+                         group_size=cfg.moe_group_size)
+            model = MoETransformerLM(**lm_kw)
         else:
             from tpu_dist.models.transformer import tiny_lm
             model = tiny_lm(**lm_kw)
@@ -347,10 +353,12 @@ class LMTrainer:
             self.data_spec = P("data", None)
             self.valid_spec = P("data")
         elif self.use_sp:
+            from tpu_dist.models.moe import MoETransformerLM
             from tpu_dist.models.transformer import tiny_lm
-            ctor = partial(tiny_lm, **{k: v for k, v in
-                                       self._model_ctor_kw.items()
-                                       if k != "attn_fn"})
+            kw = {k: v for k, v in self._model_ctor_kw.items()
+                  if k != "attn_fn"}
+            ctor = partial(MoETransformerLM if cfg.num_experts else tiny_lm,
+                           **kw)
             self._sp_ctor = ctor  # the windowed sp steps rebind it per-axis
             self.train_step = make_lm_sp_train_step(
                 ctor, self.tx, self.mesh, loss_chunk=cfg.loss_chunk)
@@ -629,7 +637,8 @@ class LMTrainer:
                 per_token = moe_lm_flops_per_token(
                     self.state.params, cfg.num_layers, cfg.seq_len,
                     cfg.d_model, cfg.num_experts, cfg.router_top_k,
-                    total_tokens=cfg.batch_size * cfg.seq_len)
+                    total_tokens=cfg.batch_size * cfg.seq_len,
+                    group_size=cfg.moe_group_size)
             else:
                 per_token = lm_flops_per_token(
                     self.state.params, cfg.num_layers, cfg.seq_len,

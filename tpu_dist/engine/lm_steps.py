@@ -378,11 +378,16 @@ def _lm_sp_step_fn(model, tx, aux_weight: float, data_axis: str,
         pos_offset = seq_idx * shard_len
 
         def loss_fn(p):
-            out, aux, _, _ = _apply_collect_aux(
+            out, aux, mass_sum, mass_n = _apply_collect_aux(
                 model, p, inputs, dropout_rng, pos_offset=pos_offset,
                 return_features=bool(loss_chunk))
             loss_sum, metrics = _lm_objective_metrics(
                 model, p, out, targets, loss_chunk)
+            # router-mass diagnostic rides the metric sums (psum'd below)
+            # so sp-MoE runs report a real RMass, like the jit modes
+            metrics = {**metrics,
+                       "router_mass_sum": jax.lax.stop_gradient(mass_sum),
+                       "router_mass_n": mass_n}
             # LOCAL mean; collectives stay OUT of the differentiated function
             # (psum's transpose under shard_map would rescale the cotangent).
             # Equal static shard sizes make mean-of-local-means == global mean.

@@ -147,6 +147,7 @@ class MoEBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attn_fn: Callable = None  # default set in __call__ to avoid import cycle
     router_top_k: int = 1
+    group_size: int = 512
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -165,7 +166,8 @@ class MoEBlock(nn.Module):
                          name="proj")(out.reshape(x.shape))
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         x = x + MoEMLP(self.num_experts, dtype=self.dtype,
-                       router_top_k=self.router_top_k, name="moe")(h, train)
+                       router_top_k=self.router_top_k,
+                       group_size=self.group_size, name="moe")(h, train)
         return x
 
 
@@ -181,6 +183,10 @@ class MoETransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attn_fn: Callable = None
     router_top_k: int = 1
+    group_size: int = 512  # router group tokens (GShard grouping; under
+                           # sequence parallelism groups are shard-local,
+                           # so a group_size dividing the shard's tokens
+                           # keeps routing identical to the dp grouping)
     remat: bool = False  # rematerialize each MoE block in the backward pass
                          # (the expert dispatch/combine tensors are the
                          # memory hogs — jax.checkpoint per block is the
@@ -198,7 +204,7 @@ class MoETransformerLM(nn.Module):
                      else MoEBlock)
         for i in range(self.num_layers):
             x = block_cls(self.num_heads, self.num_experts, self.dtype,
-                          self.attn_fn, self.router_top_k,
+                          self.attn_fn, self.router_top_k, self.group_size,
                           name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_features:
