@@ -279,9 +279,20 @@ class LMTrainer:
                 f"unsupported model-parallel axis combination {multi} "
                 "(one axis at a time, stage+model for pp x tp, or "
                 "expert+model for MoE x tp)")
-        if self.use_pp and (cfg.num_experts or cfg.fsdp):
-            raise ValueError("a 'stage' mesh axis composes only with 'data' "
-                             "(GPipe over dense TransformerLM blocks)")
+        if self.use_pp and cfg.fsdp:
+            raise ValueError("a 'stage' mesh axis does not compose with "
+                             "fsdp (blocks already shard over 'stage')")
+        if self.use_pp and cfg.num_experts:
+            # MoE x pp (round 4): GPipe only — autodiff carries the router
+            # aux losses through the tick scan; the manual-vjp 1f1b tick
+            # does not thread them. No 'model' axis: the pp x tp rule table
+            # covers dense 2-dim kernels, not stacked expert tensors.
+            if cfg.pp_schedule != "gpipe":
+                raise ValueError("MoE + pipeline requires "
+                                 "--pp-schedule gpipe")
+            if self.use_tp:
+                raise ValueError("MoE + pipeline does not compose with a "
+                                 "'model' axis")
         if self.use_ep and not cfg.num_experts:
             raise ValueError("an 'expert' mesh axis requires num_experts > 0")
         # (MoE composes with a 'seq' axis: experts are replicated and the
