@@ -39,6 +39,7 @@ def main() -> None:
         vocab_size=64, synth_tokens=2000, seed=5, print_freq=100, epochs=1,
         lr=1e-2, checkpoint_dir=os.path.join(out, "ckpt"),
         steps_per_dispatch=int(os.environ.get("TPU_DIST_TEST_K", "1")),
+        loss_chunk=int(os.environ.get("TPU_DIST_TEST_LOSS_CHUNK", "0")),
         data_placement=os.environ.get("TPU_DIST_TEST_PLACEMENT", "auto"))
     trainer = LMTrainer(cfg)
     best_ppl = trainer.fit()

@@ -154,6 +154,24 @@ def test_multiprocess_lm_params_match_single_process(tmp_path):
     assert res1["best_ppl"] == pytest.approx(res2["best_ppl"], rel=1e-3)
 
 
+def test_multiprocess_lm_loss_chunk_matches_full(tmp_path):
+    """--loss-chunk (round 4, chunked vocab CE) across 2 REAL processes
+    trains to the same parameters as the 2-process full-logits run — the
+    chunked custom_vjp is process-topology-invariant."""
+    worker = os.path.join(ROOT, "tests", "mp_lm_worker.py")
+    full = run_workers(str(tmp_path), "lm-full", nprocs=2,
+                       local_devices=2, worker=worker)
+    chunk = run_workers(str(tmp_path), "lm-chunk", nprocs=2,
+                        local_devices=2, worker=worker,
+                        extra_env={"TPU_DIST_TEST_LOSS_CHUNK": "40"})
+    (res1, p1), (res2, p2) = _load(full), _load(chunk)
+    assert res1["process_count"] == res2["process_count"] == 2
+    assert p1.keys() == p2.keys() and len(p1) > 0
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p2[k], rtol=2e-4, atol=2e-5,
+                                   err_msg=f"leaf {k}")
+
+
 @pytest.mark.parametrize("mode", ["tp", "sp", "pp", "ep"])
 def test_multiprocess_model_parallel_matches_single(tmp_path, mode):
     """TP / SP / PP / EP train steps with the MODEL axis spanning 2 REAL
