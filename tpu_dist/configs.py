@@ -187,6 +187,7 @@ class LMConfig:
     resume: str = ""
     checkpoint_dir: str = ""
     log_csv: str = ""
+    profile_dir: str = ""          # jax.profiler trace dir if set (C22)
 
 
 def add_args(parser: argparse.ArgumentParser, defaults) -> None:

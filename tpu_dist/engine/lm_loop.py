@@ -674,6 +674,12 @@ class LMTrainer:
         cfg = self.cfg
         if cfg.evaluate:
             return self.validate(0)[1]
+        profiling = bool(cfg.profile_dir) and self.is_main
+        if profiling:
+            # real XLA trace (per-op device time, HBM, MXU utilization) —
+            # the same C22 telemetry hook the image Trainer has
+            import jax.profiler
+            jax.profiler.start_trace(cfg.profile_dir)
         try:
             self._fit_epochs()
         except KeyboardInterrupt:
@@ -691,6 +697,11 @@ class LMTrainer:
             raise
         finally:
             ckpt.wait_for_async_save()
+            if profiling:
+                # flush the trace even on OOM/interrupt — a failing run is
+                # exactly the one worth profiling
+                import jax.profiler
+                jax.profiler.stop_trace()
         return self.best_ppl
 
     def _fit_epochs(self) -> None:
