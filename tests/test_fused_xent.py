@@ -184,3 +184,25 @@ def test_loss_chunk_under_tensor_parallel_matches_dp():
     for k in flat_dp:
         np.testing.assert_allclose(flat_tp[k], flat_dp[k],
                                    rtol=2e-4, atol=1e-5, err_msg=k)
+
+
+def test_lm_trainer_pp_loss_chunk_matches(tmp_path):
+    """--loss-chunk in the gpipe pipeline (the last-stage chunked head,
+    round 4) trains to the same parameters as the pp full-logits path."""
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    def vec(tr):
+        return np.concatenate([np.asarray(x, np.float32).ravel()
+                               for x in jax.tree_util.tree_leaves(
+                                   jax.device_get(tr.state.params))])
+
+    tiny = dict(mesh_shape=(2, 4), mesh_axes=("data", "stage"),
+                pp_microbatches=2, batch_size=8, seq_len=32, d_model=32,
+                num_layers=4, num_heads=2, vocab_size=64, synth_tokens=3000,
+                seed=3, print_freq=100, epochs=1, lr=1e-2,
+                data_placement="host")
+    tr_full = LMTrainer(LMConfig(**tiny)); tr_full.fit()
+    tr_chunk = LMTrainer(LMConfig(loss_chunk=40, **tiny)); tr_chunk.fit()
+    np.testing.assert_allclose(vec(tr_chunk), vec(tr_full),
+                               rtol=1e-4, atol=1e-5)

@@ -188,11 +188,14 @@ class LMTrainer:
                 from tpu_dist.parallel.pp import (
                     make_lm_pp_indexed_eval_step,
                     make_lm_pp_indexed_multi_train_step)
+                chunk = (cfg.loss_chunk
+                         if cfg.pp_schedule == "gpipe" else 0)
                 self.window_step = make_lm_pp_indexed_multi_train_step(
                     self.model, self.tx, self.mesh, cfg.pp_microbatches,
-                    schedule=cfg.pp_schedule)
+                    schedule=cfg.pp_schedule, loss_chunk=chunk)
                 self.window_eval_step = make_lm_pp_indexed_eval_step(
-                    self.model, self.mesh, cfg.pp_microbatches)
+                    self.model, self.mesh, cfg.pp_microbatches,
+                    loss_chunk=chunk)
             elif self.use_sp:
                 from tpu_dist.engine.lm_steps import (
                     make_lm_sp_indexed_eval_step,
@@ -350,17 +353,21 @@ class LMTrainer:
             if cfg.pp_schedule not in ("gpipe", "1f1b"):
                 raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r} "
                                  "(gpipe|1f1b)")
-            if cfg.loss_chunk:
-                self.log("warning: --loss-chunk applies to the jit/sp modes; "
-                         "pipeline schedules keep their per-stage head path "
+            if cfg.loss_chunk and cfg.pp_schedule == "1f1b":
+                self.log("warning: --loss-chunk applies to the gpipe "
+                         "schedule (1f1b keeps its per-stage head vjp) "
                          "— ignored")
-            make_pp = (make_lm_pp_1f1b_train_step
-                       if cfg.pp_schedule == "1f1b"
-                       else make_lm_pp_train_step)
-            self.train_step = make_pp(
-                self.model, self.tx, self.mesh, cfg.pp_microbatches)
+            if cfg.pp_schedule == "1f1b":
+                self.train_step = make_lm_pp_1f1b_train_step(
+                    self.model, self.tx, self.mesh,
+                    cfg.pp_microbatches)
+            else:
+                self.train_step = make_lm_pp_train_step(
+                    self.model, self.tx, self.mesh,
+                    cfg.pp_microbatches, loss_chunk=cfg.loss_chunk)
             self.eval_step = make_lm_pp_eval_step(
-                self.model, self.mesh, cfg.pp_microbatches)
+                self.model, self.mesh, cfg.pp_microbatches,
+                loss_chunk=cfg.loss_chunk)
             self.data_spec = P("data", None)
             self.valid_spec = P("data")
         elif self.use_sp:
