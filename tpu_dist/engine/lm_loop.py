@@ -367,7 +367,8 @@ class LMTrainer:
                     cfg.pp_microbatches, loss_chunk=cfg.loss_chunk)
             self.eval_step = make_lm_pp_eval_step(
                 self.model, self.mesh, cfg.pp_microbatches,
-                loss_chunk=cfg.loss_chunk)
+                loss_chunk=(cfg.loss_chunk
+                            if cfg.pp_schedule == "gpipe" else 0))
             self.data_spec = P("data", None)
             self.valid_spec = P("data")
         elif self.use_sp:

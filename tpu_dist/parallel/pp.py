@@ -265,7 +265,8 @@ def _pp_forward_builder(model, mesh: Mesh, num_microbatches: int,
     stage that holds MoE blocks (0.0 for dense models), and the metrics
     carry per-stage router_mass sums the same way. ``row_valid`` (B,)
     masks sampler wrap-padding rows (ones for training)."""
-    from tpu_dist.engine.lm_steps import lm_loss_and_metrics
+    from tpu_dist.engine.lm_steps import (_chunked_loss_metrics,
+                                          lm_loss_and_metrics)
 
     n_stages = mesh.shape[stage_axis]
     m = num_microbatches
@@ -354,14 +355,11 @@ def _pp_forward_builder(model, mesh: Mesh, num_microbatches: int,
                                     targets.shape).astype(jnp.float32)
             if loss_chunk:
                 # chunked head+CE (ops.fused_xent): the custom_vjp has
-                # no collectives, so it is cond-safe on the last stage
-                from tpu_dist.ops.fused_xent import chunked_softmax_xent
-                loss_sum, correct = chunked_softmax_xent(
-                    x, eh["lm_head"]["kernel"], targets, mask,
-                    loss_chunk, dtype)
-                return loss_sum, {"loss_sum": loss_sum,
-                                  "correct1": correct,
-                                  "count": jnp.sum(mask)}
+                # no collectives, so it is cond-safe on the last stage;
+                # the SHARED helper builds the metric dict so the key
+                # set cannot drift from the jit/sp paths
+                return _chunked_loss_metrics(model, eh, x, targets,
+                                             mask, loss_chunk)
             logits = (x.astype(dtype)
                       @ eh["lm_head"]["kernel"].astype(dtype)
                       ).astype(jnp.float32)
@@ -493,7 +491,8 @@ def _pp_1f1b_step_builder(model, tx, mesh: Mesh, num_microbatches: int,
                           data_axis: str, stage_axis: str) -> Callable:
     """Per-device 1F1B train step (runs INSIDE shard_map), shared by the
     single-batch and indexed-window wrappers."""
-    from tpu_dist.engine.lm_steps import lm_loss_and_metrics
+    from tpu_dist.engine.lm_steps import (_chunked_loss_metrics,
+                                          lm_loss_and_metrics)
 
     S = mesh.shape[stage_axis]
     M = num_microbatches
