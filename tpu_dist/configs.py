@@ -127,6 +127,8 @@ class LMConfig:
                                    # under sp, groups are shard-local — a
                                    # size dividing the shard keeps routing
                                    # identical to the dp grouping)
+    moe_aux_weight: float = 0.01   # weight of the router balance+z losses
+                                   # in the objective (every MoE mode)
     attn: str = "full"             # full | blockwise | flash (Pallas FA2)
     attn_block: int = 1024         # KV block for blockwise/flash (clamped
                                    # to seq_len; 1024 measured ~20% faster
@@ -135,7 +137,7 @@ class LMConfig:
     loss_chunk: int = 0            # >0: chunked head+CE (ops.fused_xent) —
                                    # the (B,L,V) logits never materialize;
                                    # N rows of logits at a time, backward
-                                   # recomputes (jit + sp modes)
+                                   # recomputes (jit, sp, and gpipe-pp)
     precision: str = "fp32"        # fp32 | bf16
 
     # -- schedule

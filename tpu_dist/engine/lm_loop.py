@@ -165,7 +165,8 @@ class LMTrainer:
             from tpu_dist.engine.lm_steps import (
                 make_lm_grad_accum_train_step)
             self.train_step = make_lm_grad_accum_train_step(
-                self.model, self.tx, self.mesh, loss_chunk=cfg.loss_chunk)
+                self.model, self.tx, self.mesh, loss_chunk=cfg.loss_chunk,
+                aux_weight=cfg.moe_aux_weight)
         rows_bytes = (len(self.train_ds) + len(self.val_ds)) * \
             (cfg.seq_len + 1) * 4
         fits = rows_bytes <= int(os.environ.get("TPU_DIST_DEVICE_DATA_MAX",
@@ -192,7 +193,8 @@ class LMTrainer:
                          if cfg.pp_schedule == "gpipe" else 0)
                 self.window_step = make_lm_pp_indexed_multi_train_step(
                     self.model, self.tx, self.mesh, cfg.pp_microbatches,
-                    schedule=cfg.pp_schedule, loss_chunk=chunk)
+                    schedule=cfg.pp_schedule, loss_chunk=chunk,
+                    aux_weight=cfg.moe_aux_weight)
                 self.window_eval_step = make_lm_pp_indexed_eval_step(
                     self.model, self.mesh, cfg.pp_microbatches,
                     loss_chunk=chunk)
@@ -202,13 +204,15 @@ class LMTrainer:
                     make_lm_sp_indexed_multi_train_step)
                 self.window_step = make_lm_sp_indexed_multi_train_step(
                     self._sp_ctor, self.tx, self.mesh,
-                    loss_chunk=cfg.loss_chunk)
+                    loss_chunk=cfg.loss_chunk,
+                    aux_weight=cfg.moe_aux_weight)
                 self.window_eval_step = make_lm_sp_indexed_eval_step(
                     self._sp_ctor, self.mesh, loss_chunk=cfg.loss_chunk)
             else:
                 self.window_step = make_lm_indexed_multi_train_step(
                     self.model, self.tx, self.mesh,
-                    loss_chunk=cfg.loss_chunk)
+                    loss_chunk=cfg.loss_chunk,
+                    aux_weight=cfg.moe_aux_weight)
                 self.window_eval_step = make_lm_indexed_eval_step(
                     self.model, self.mesh, loss_chunk=cfg.loss_chunk)
         elif self.k > 1:
@@ -364,7 +368,8 @@ class LMTrainer:
             else:
                 self.train_step = make_lm_pp_train_step(
                     self.model, self.tx, self.mesh,
-                    cfg.pp_microbatches, loss_chunk=cfg.loss_chunk)
+                    cfg.pp_microbatches, loss_chunk=cfg.loss_chunk,
+                    aux_weight=cfg.moe_aux_weight)
             self.eval_step = make_lm_pp_eval_step(
                 self.model, self.mesh, cfg.pp_microbatches,
                 loss_chunk=(cfg.loss_chunk
@@ -380,14 +385,16 @@ class LMTrainer:
                            **kw)
             self._sp_ctor = ctor  # the windowed sp steps rebind it per-axis
             self.train_step = make_lm_sp_train_step(
-                ctor, self.tx, self.mesh, loss_chunk=cfg.loss_chunk)
+                ctor, self.tx, self.mesh, loss_chunk=cfg.loss_chunk,
+                aux_weight=cfg.moe_aux_weight)
             self.eval_step = make_lm_sp_eval_step(
                 ctor, self.mesh, loss_chunk=cfg.loss_chunk)
             self.data_spec = P("data", "seq")
             self.valid_spec = P("data")
         else:
             self.train_step = make_lm_train_step(
-                self.model, self.tx, self.mesh, loss_chunk=cfg.loss_chunk)
+                self.model, self.tx, self.mesh, loss_chunk=cfg.loss_chunk,
+                aux_weight=cfg.moe_aux_weight)
             self.eval_step = make_lm_eval_step(
                 self.model, self.mesh, loss_chunk=cfg.loss_chunk)
             self.data_spec = P("data")
