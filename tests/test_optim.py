@@ -120,3 +120,29 @@ def test_adamw_update_matches_torch_adamw():
 def test_unknown_optimizer_kind_raises():
     with pytest.raises(ValueError, match="sgd|adamw"):
         make_optimizer(0.1, kind="rmsprop")
+
+
+def test_grad_clip_by_global_norm():
+    """grad_clip: raw grads scale to the clip norm BEFORE momentum/adam
+    statistics (torch clip_grad_norm_ placement); small grads untouched."""
+    g = {"a": jnp.full((3,), 3.0), "b": jnp.full((4,), 4.0)}
+    # global norm = sqrt(9*3 + 16*4) = sqrt(91) > 1
+    params = jax.tree.map(jnp.zeros_like, g)
+    tx = make_optimizer(1.0, momentum=0.0, weight_decay=0.0,
+                        schedule=lambda s: 1.0, grad_clip=1.0)
+    u, _ = tx.update(g, tx.init(params), params)
+    gn = float(np.sqrt(sum(float(jnp.sum(x * x))
+                           for x in jax.tree.leaves(u))))
+    np.testing.assert_allclose(gn, 1.0, rtol=1e-6)  # clipped to the norm
+
+    tiny = jax.tree.map(lambda x: x * 1e-3, g)
+    u2, _ = tx.update(tiny, tx.init(params), params)
+    for k in g:
+        np.testing.assert_allclose(np.asarray(u2[k]), -np.asarray(tiny[k]),
+                                   rtol=1e-6)  # under the norm: untouched
+
+    # adamw variant accepts the knob and still steps
+    tx2 = make_optimizer(1e-3, kind="adamw", weight_decay=0.0,
+                         schedule=lambda s: 1e-3, grad_clip=1.0)
+    u3, _ = tx2.update(g, tx2.init(params), params)
+    assert all(np.all(np.isfinite(x)) for x in jax.tree.leaves(u3))

@@ -153,6 +153,8 @@ class LMConfig:
     adam_b2: float = 0.95
     adam_eps: float = 1e-8
     weight_decay: float = 0.0
+    grad_clip: float = 0.0         # >0: clip raw grads by global norm
+                                   # before the optimizer statistics
     lr_schedule: str = "constant"  # constant | cosine | step, each with
                                    # linear warmup (ops.optim.lm_lr_schedule;
                                    # resume-safe — the step count rides in

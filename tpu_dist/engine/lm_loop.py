@@ -125,7 +125,8 @@ class LMTrainer:
         self.tx = make_optimizer(cfg.lr, cfg.momentum, cfg.weight_decay,
                                  schedule=self.lr_schedule,
                                  kind=cfg.optimizer, b1=cfg.adam_b1,
-                                 b2=cfg.adam_b2, eps=cfg.adam_eps)
+                                 b2=cfg.adam_b2, eps=cfg.adam_eps,
+                                 grad_clip=cfg.grad_clip)
         if self.use_pp:
             from tpu_dist.parallel.pp import stack_pipeline_params
             params = stack_pipeline_params(params, shape["stage"])

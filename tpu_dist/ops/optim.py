@@ -82,7 +82,8 @@ def lm_lr_schedule(base_lr: float, kind: str = "constant",
 def make_optimizer(lr: float, momentum: float = 0.9, weight_decay: float = 1e-4,
                    steps_per_epoch: int = 1, lr_step_epochs: int = 30,
                    schedule: Optional[Callable] = None, kind: str = "sgd",
-                   b1: float = 0.9, b2: float = 0.95, eps: float = 1e-8
+                   b1: float = 0.9, b2: float = 0.95, eps: float = 1e-8,
+                   grad_clip: float = 0.0
                    ) -> optax.GradientTransformation:
     """torch.optim.SGD(momentum, weight_decay)-equivalent with step-decay LR,
     or decoupled AdamW (``kind='adamw'``) — the transformer-family default
@@ -94,13 +95,17 @@ def make_optimizer(lr: float, momentum: float = 0.9, weight_decay: float = 1e-4,
     placement around the allreduce — NOT here, so it cannot double-apply.
     """
     sched = schedule or step_decay_schedule(lr, steps_per_epoch, lr_step_epochs)
+    # grad_clip > 0: clip the RAW gradient by global norm BEFORE any
+    # momentum/adam statistics (torch.nn.utils.clip_grad_norm_ placement)
+    clip = ([optax.clip_by_global_norm(grad_clip)] if grad_clip > 0 else [])
     if kind == "adamw":
         # decoupled wd (AdamW): applied AFTER the adam scaling, with lr
-        return optax.adamw(learning_rate=sched, b1=b1, b2=b2, eps=eps,
-                           weight_decay=weight_decay)
+        return optax.chain(*clip, optax.adamw(
+            learning_rate=sched, b1=b1, b2=b2, eps=eps,
+            weight_decay=weight_decay))
     if kind != "sgd":
         raise ValueError(f"unknown optimizer kind {kind!r} (sgd|adamw)")
-    chain = []
+    chain = list(clip)
     if weight_decay:
         chain.append(optax.add_decayed_weights(weight_decay))
     # torch SGD momentum: buf = mu*buf + grad; update = -lr*buf
