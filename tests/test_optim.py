@@ -146,3 +146,25 @@ def test_grad_clip_by_global_norm():
                          schedule=lambda s: 1e-3, grad_clip=1.0)
     u3, _ = tx2.update(g, tx2.init(params), params)
     assert all(np.all(np.isfinite(x)) for x in jax.tree.leaves(u3))
+
+
+def test_grad_clip_zero_keeps_adamw_state_structure():
+    """grad_clip=0 must leave the adamw opt_state pytree IDENTICAL to the
+    pre-clip-feature structure (resume of older checkpoints)."""
+    import optax
+    params = {"w": jnp.ones((3,))}
+    st_plain = optax.adamw(lambda s: 1e-3).init(params)
+    st_ours = make_optimizer(1e-3, kind="adamw",
+                             schedule=lambda s: 1e-3).init(params)
+    assert (jax.tree_util.tree_structure(st_ours)
+            == jax.tree_util.tree_structure(st_plain))
+
+
+def test_grad_clip_rejected_under_pipeline():
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+    with pytest.raises(ValueError, match="grad-clip"):
+        LMTrainer(LMConfig(mesh_shape=(2, 4), mesh_axes=("data", "stage"),
+                           grad_clip=1.0, batch_size=8, seq_len=32,
+                           d_model=32, num_layers=4, num_heads=2,
+                           vocab_size=64, synth_tokens=2000))

@@ -287,6 +287,13 @@ class LMTrainer:
                 f"unsupported model-parallel axis combination {multi} "
                 "(one axis at a time, stage+model for pp x tp, or "
                 "expert+model for MoE x tp)")
+        if self.use_pp and cfg.grad_clip > 0:
+            raise ValueError(
+                "--grad-clip does not compose with pipeline parallelism: "
+                "block gradients are stage-local inside the pp shard_map, "
+                "so a per-device global-norm clip would use a different "
+                "norm per stage and desynchronize the replicated "
+                "embed/head parameters")
         if self.use_pp and cfg.fsdp:
             raise ValueError("a 'stage' mesh axis does not compose with "
                              "fsdp (blocks already shard over 'stage')")

@@ -99,10 +99,12 @@ def make_optimizer(lr: float, momentum: float = 0.9, weight_decay: float = 1e-4,
     # momentum/adam statistics (torch.nn.utils.clip_grad_norm_ placement)
     clip = ([optax.clip_by_global_norm(grad_clip)] if grad_clip > 0 else [])
     if kind == "adamw":
-        # decoupled wd (AdamW): applied AFTER the adam scaling, with lr
-        return optax.chain(*clip, optax.adamw(
-            learning_rate=sched, b1=b1, b2=b2, eps=eps,
-            weight_decay=weight_decay))
+        # decoupled wd (AdamW): applied AFTER the adam scaling, with lr.
+        # Unwrapped when no clip so the opt_state pytree structure (and
+        # therefore existing adamw checkpoints) is unchanged at the default.
+        adamw = optax.adamw(learning_rate=sched, b1=b1, b2=b2, eps=eps,
+                            weight_decay=weight_decay)
+        return optax.chain(*clip, adamw) if clip else adamw
     if kind != "sgd":
         raise ValueError(f"unknown optimizer kind {kind!r} (sgd|adamw)")
     chain = list(clip)
