@@ -483,9 +483,11 @@ def test_moe_pp_gpipe_matches_dp():
                                    rtol=2e-4, atol=1e-5, err_msg=k)
 
 
-def test_moe_pp_trains_via_lm_trainer_and_1f1b_rejected():
-    """LMTrainer drives MoE x pp-gpipe end to end (aux ON); 1f1b + MoE is
-    a clear error, not silent dense-block math."""
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_moe_pp_trains_via_lm_trainer(schedule):
+    """LMTrainer drives MoE x pp end to end (aux ON) under BOTH schedules —
+    the round-4 'MoE + pipeline requires gpipe' rejection is gone: the
+    1f1b tick threads the router aux through its manual vjp."""
     from tpu_dist.configs import LMConfig
     from tpu_dist.engine.lm_loop import LMTrainer
 
@@ -493,17 +495,150 @@ def test_moe_pp_trains_via_lm_trainer_and_1f1b_rejected():
               d_model=32, num_layers=4, num_heads=2, vocab_size=64,
               synth_tokens=3000, seed=3, epochs=2, optimizer="adamw",
               lr=3e-3, print_freq=100, data_placement="host",
-              pp_microbatches=2)
+              pp_microbatches=2, pp_schedule=schedule)
     cfg = LMConfig(mesh_shape=(2, 4), mesh_axes=("data", "stage"), **kw)
     tr = LMTrainer(cfg)
     tr.fit()
     loss, ppl, acc = tr.validate()
     assert np.isfinite(loss) and ppl < 64
 
-    with pytest.raises(ValueError, match="gpipe"):
-        LMTrainer(LMConfig(mesh_shape=(2, 4),
-                           mesh_axes=("data", "stage"),
-                           pp_schedule="1f1b", **kw))
+
+def test_moe_pp_tp_trains_via_lm_trainer():
+    """The TRAINER accepts MoE over a (data, stage, model) mesh — the
+    round-5 composition reachable end to end, not just via the pp.py
+    makers (guard regression: the 'MoE + pure tensor parallelism' check
+    must exempt pipeline meshes)."""
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    cfg = LMConfig(mesh_shape=(2, 2, 2),
+                   mesh_axes=("data", "stage", "model"),
+                   num_experts=4, moe_group_size=8, batch_size=8,
+                   seq_len=32, d_model=32, num_layers=4, num_heads=2,
+                   vocab_size=64, synth_tokens=3000, seed=3, epochs=2,
+                   optimizer="adamw", lr=3e-3, print_freq=100,
+                   data_placement="host", pp_microbatches=2)
+    tr = LMTrainer(cfg)
+    assert tr.mode == "pp-gpipe+tp"
+    tr.fit()
+    loss, ppl, acc = tr.validate()
+    assert np.isfinite(loss) and ppl < 64
+
+
+def test_moe_pp_1f1b_matches_gpipe_with_aux():
+    """MoE x 1f1b == MoE x GPipe *with the router aux loss ON* (round 5):
+    the manual-vjp schedule must thread aux_weight/M per microbatch through
+    each stage's vjp AND propagate the aux input-cotangent across the
+    backward ppermute ring. GPipe-by-autodiff on the SAME microbatch
+    geometry is the ground truth — the aux term is a per-apply mean of a
+    product of group means, so it is schedule-geometry-dependent by
+    construction (dp's global-batch aux differs mathematically; the CE
+    loss and routing stay dp-identical and are asserted against dp in
+    test_moe_pp_gpipe_matches_dp)."""
+    from tpu_dist.parallel.pp import (make_lm_pp_1f1b_train_step,
+                                      make_lm_pp_train_step,
+                                      shard_state_pp, stack_pipeline_params,
+                                      unstack_pipeline_params)
+
+    rng_np = np.random.default_rng(5)
+    tokens = rng_np.integers(0, V, (8, L + 1)).astype(np.int32)
+    inputs, targets = make_lm_batches(tokens)
+    model = MoETransformerLM(vocab_size=V, max_len=L, num_experts=E,
+                             num_layers=4, group_size=8)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, L), jnp.int32), train=False)["params"]
+    tx = make_optimizer(0.05, 0.9, 0.0, steps_per_epoch=1000)
+    key = jax.random.PRNGKey(9)
+    mesh_pp = make_mesh((2, 4), ("data", "stage"))
+    sh_pp = NamedSharding(mesh_pp, P("data", None))
+    di, dt = jax.device_put(inputs, sh_pp), jax.device_put(targets, sh_pp)
+
+    def run(maker):
+        pp_params = stack_pipeline_params(params, 4)
+        st = shard_state_pp(mesh_pp, TrainState.create(pp_params, {}, tx))
+        step = maker(model, tx, mesh_pp, 2, donate=False, aux_weight=0.05)
+        st2, m = step(st, di, dt, key)
+        return (unstack_pipeline_params(jax.device_get(st2.params)),
+                jax.device_get(m))
+
+    p_g, m_g = run(make_lm_pp_train_step)
+    p_f, m_f = run(make_lm_pp_1f1b_train_step)
+
+    np.testing.assert_allclose(float(m_f["loss_sum"]),
+                               float(m_g["loss_sum"]), rtol=1e-5)
+    # the router-mass diagnostic reaches the 1f1b metrics too
+    assert float(m_f["router_mass_n"]) > 0
+    assert float(m_f["router_mass_n"]) == pytest.approx(
+        float(m_g["router_mass_n"]), rel=1e-6)
+    flat_g = {jax.tree_util.keystr(p): np.asarray(v) for p, v in
+              jax.tree_util.tree_flatten_with_path(p_g)[0]}
+    flat_f = {jax.tree_util.keystr(p): np.asarray(v) for p, v in
+              jax.tree_util.tree_flatten_with_path(p_f)[0]}
+    assert flat_g.keys() == flat_f.keys()
+    for k in flat_g:
+        np.testing.assert_allclose(flat_f[k], flat_g[k],
+                                   rtol=2e-4, atol=1e-5, err_msg=k)
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_moe_pp_tp_matches_pp(schedule):
+    """MoE x pp x tp (round 5, the last composition hole): a (data=2,
+    stage=2, model=2) mesh with the stacked expert kernels Megatron-split
+    over 'model' on top of their 'stage' shard must reproduce the same
+    schedule on a plain (data=2, stage=2) mesh — with the router aux loss
+    ON, so the only variable is the 'model' partitioning (pp == dp is
+    covered by test_moe_pp_gpipe_matches_dp; aux is schedule-geometry
+    dependent, see test_moe_pp_1f1b_matches_gpipe_with_aux)."""
+    from tpu_dist.parallel.pp import (make_lm_pp_1f1b_train_step,
+                                      make_lm_pp_train_step,
+                                      shard_state_pp, stack_pipeline_params,
+                                      unstack_pipeline_params)
+
+    rng_np = np.random.default_rng(7)
+    tokens = rng_np.integers(0, V, (8, L + 1)).astype(np.int32)
+    inputs, targets = make_lm_batches(tokens)
+    model = MoETransformerLM(vocab_size=V, max_len=L, num_experts=E,
+                             num_layers=4, group_size=8)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, L), jnp.int32), train=False)["params"]
+    tx = make_optimizer(0.05, 0.9, 0.0, steps_per_epoch=1000)
+    key = jax.random.PRNGKey(9)
+    maker = (make_lm_pp_1f1b_train_step if schedule == "1f1b"
+             else make_lm_pp_train_step)
+
+    def run(mesh_shape, axes):
+        ndev = int(np.prod(mesh_shape))
+        mesh = make_mesh(mesh_shape, axes, devices=jax.devices()[:ndev])
+        pp_params = stack_pipeline_params(params, mesh.shape["stage"])
+        st = shard_state_pp(mesh, TrainState.create(pp_params, {}, tx))
+        if "model" in axes:
+            # expert kernels split over BOTH stage and model axes: w_in is
+            # (S, layers, E, D, F) with S on 'stage' and F on 'model'
+            w_in = st.params["blocks"]["moe"]["w_in"]
+            local = w_in.addressable_shards[0].data.shape
+            assert local[0] == w_in.shape[0] // 2
+            assert local[-1] == w_in.shape[-1] // 2
+        step = maker(model, tx, mesh, 2, donate=False, aux_weight=0.05)
+        sh = NamedSharding(mesh, P("data", None))
+        st2, m = step(st, jax.device_put(inputs, sh),
+                      jax.device_put(targets, sh), key)
+        return (unstack_pipeline_params(jax.device_get(st2.params)),
+                jax.device_get(m))
+
+    p_pp, m_pp = run((2, 2), ("data", "stage"))
+    p_tp, m_tp = run((2, 2, 2), ("data", "stage", "model"))
+
+    np.testing.assert_allclose(float(m_tp["loss_sum"]),
+                               float(m_pp["loss_sum"]), rtol=1e-4)
+    flat_pp = {jax.tree_util.keystr(p): np.asarray(v) for p, v in
+               jax.tree_util.tree_flatten_with_path(p_pp)[0]}
+    flat_tp = {jax.tree_util.keystr(p): np.asarray(v) for p, v in
+               jax.tree_util.tree_flatten_with_path(p_tp)[0]}
+    assert flat_pp.keys() == flat_tp.keys()
+    for k in flat_pp:
+        np.testing.assert_allclose(flat_tp[k], flat_pp[k],
+                                   rtol=5e-4, atol=1e-5,
+                                   err_msg=f"{schedule} {k}")
 
 
 def test_moe_aux_weight_flag_reaches_objective():

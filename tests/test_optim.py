@@ -160,14 +160,39 @@ def test_grad_clip_zero_keeps_adamw_state_structure():
             == jax.tree_util.tree_structure(st_plain))
 
 
-def test_grad_clip_rejected_under_pipeline():
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_grad_clip_pp_matches_dp(schedule):
+    """--grad-clip under pipeline parallelism (round 5 — was rejected in
+    round 4): block grads are stage-LOCAL inside the pp shard_map, so the
+    pp steps clip by a cross-stage psum'd global norm
+    (parallel.pp._clip_pp_grads) instead of optax's per-device clip —
+    pp+clip must train identically to dp+clip under both schedules, which
+    also proves the replicated embed/head update stays synchronized."""
     from tpu_dist.configs import LMConfig
     from tpu_dist.engine.lm_loop import LMTrainer
-    with pytest.raises(ValueError, match="grad-clip"):
-        LMTrainer(LMConfig(mesh_shape=(2, 4), mesh_axes=("data", "stage"),
-                           grad_clip=1.0, batch_size=8, seq_len=32,
-                           d_model=32, num_layers=4, num_heads=2,
-                           vocab_size=64, synth_tokens=2000))
+
+    # lr/clip chosen so the clip actually TRIGGERS (raw grad norm at init
+    # far exceeds 0.05 at this geometry) — an untriggered clip would pass
+    # this test with an identity scale
+    kw = dict(batch_size=8, seq_len=32, d_model=32, num_layers=4,
+              num_heads=2, vocab_size=64, synth_tokens=2000, seed=3,
+              epochs=1, lr=3e-2, grad_clip=0.05, print_freq=100,
+              data_placement="host")
+
+    def vec(tr):
+        from tpu_dist.parallel.pp import unstack_pipeline_params
+        params = jax.device_get(tr.state.params)
+        if "blocks" in params:
+            params = unstack_pipeline_params(params)
+        flat = {jax.tree_util.keystr(p): np.asarray(v, np.float32) for p, v
+                in jax.tree_util.tree_flatten_with_path(params)[0]}
+        return np.concatenate([flat[k].ravel() for k in sorted(flat)])
+
+    dp = LMTrainer(LMConfig(**kw)); dp.fit()
+    pp = LMTrainer(LMConfig(mesh_shape=(2, 4), mesh_axes=("data", "stage"),
+                            pp_microbatches=2, pp_schedule=schedule, **kw))
+    pp.fit()
+    np.testing.assert_allclose(vec(pp), vec(dp), rtol=2e-3, atol=1e-4)
 
 
 def test_grad_clip_sp_matches_dp():

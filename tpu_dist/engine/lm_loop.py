@@ -122,11 +122,16 @@ class LMTrainer:
             cfg.lr, cfg.lr_schedule, warmup_steps=cfg.warmup_steps,
             total_steps=total_steps, steps_per_epoch=self.steps_per_epoch,
             step_epochs=cfg.lr_step_epochs, min_frac=cfg.lr_min_frac)
+        # pp clips inside the step by the cross-stage global norm
+        # (parallel.pp._clip_pp_grads), so its optax chain carries no clip
+        # of its own — which also keeps the opt_state pytree structure
+        # independent of the --grad-clip flag under pp
         self.tx = make_optimizer(cfg.lr, cfg.momentum, cfg.weight_decay,
                                  schedule=self.lr_schedule,
                                  kind=cfg.optimizer, b1=cfg.adam_b1,
                                  b2=cfg.adam_b2, eps=cfg.adam_eps,
-                                 grad_clip=cfg.grad_clip)
+                                 grad_clip=0.0 if self.use_pp
+                                 else cfg.grad_clip)
         if self.use_pp:
             from tpu_dist.parallel.pp import stack_pipeline_params
             params = stack_pipeline_params(params, shape["stage"])
@@ -190,15 +195,14 @@ class LMTrainer:
                 from tpu_dist.parallel.pp import (
                     make_lm_pp_indexed_eval_step,
                     make_lm_pp_indexed_multi_train_step)
-                chunk = (cfg.loss_chunk
-                         if cfg.pp_schedule == "gpipe" else 0)
                 self.window_step = make_lm_pp_indexed_multi_train_step(
                     self.model, self.tx, self.mesh, cfg.pp_microbatches,
-                    schedule=cfg.pp_schedule, loss_chunk=chunk,
-                    aux_weight=cfg.moe_aux_weight)
+                    schedule=cfg.pp_schedule, loss_chunk=cfg.loss_chunk,
+                    aux_weight=cfg.moe_aux_weight,
+                    grad_clip=cfg.grad_clip)
                 self.window_eval_step = make_lm_pp_indexed_eval_step(
                     self.model, self.mesh, cfg.pp_microbatches,
-                    loss_chunk=chunk)
+                    loss_chunk=cfg.loss_chunk)
             elif self.use_sp:
                 from tpu_dist.engine.lm_steps import (
                     make_lm_sp_indexed_eval_step,
@@ -287,36 +291,27 @@ class LMTrainer:
                 f"unsupported model-parallel axis combination {multi} "
                 "(one axis at a time, stage+model for pp x tp, or "
                 "expert+model for MoE x tp)")
-        if self.use_pp and cfg.grad_clip > 0:
-            raise ValueError(
-                "--grad-clip does not compose with pipeline parallelism: "
-                "block gradients are stage-local inside the pp shard_map, "
-                "so a per-device global-norm clip would use a different "
-                "norm per stage and desynchronize the replicated "
-                "embed/head parameters")
         if self.use_pp and cfg.fsdp:
             raise ValueError("a 'stage' mesh axis does not compose with "
                              "fsdp (blocks already shard over 'stage')")
-        if self.use_pp and cfg.num_experts:
-            # MoE x pp (round 4): GPipe only — autodiff carries the router
-            # aux losses through the tick scan; the manual-vjp 1f1b tick
-            # does not thread them. No 'model' axis: the pp x tp rule table
-            # covers dense 2-dim kernels, not stacked expert tensors.
-            if cfg.pp_schedule != "gpipe":
-                raise ValueError("MoE + pipeline requires "
-                                 "--pp-schedule gpipe")
-            if self.use_tp:
-                raise ValueError("MoE + pipeline does not compose with a "
-                                 "'model' axis")
+        # (--grad-clip composes with pp since round 5: the pp steps clip by
+        # the cross-stage global norm — parallel.pp._clip_pp_grads — so the
+        # optax chain must NOT carry its own per-device clip. MoE composes
+        # with both pp schedules and with pp x tp: GPipe carries the router
+        # aux through autodiff, 1f1b threads it as an explicit vjp
+        # cotangent, and pp_tp_placement_specs shards the stacked expert
+        # kernels Megatron-style over 'model'.)
         if self.use_ep and not cfg.num_experts:
             raise ValueError("an 'expert' mesh axis requires num_experts > 0")
         # (MoE composes with a 'seq' axis: experts are replicated and the
         # GShard dispatch is group-local math, so it runs unchanged inside
         # the sp shard_map — router groups become shard-local; a
         # --moe-group-size dividing the shard keeps routing dp-identical)
-        if self.use_tp and cfg.num_experts and not self.use_ep:
+        if (self.use_tp and cfg.num_experts
+                and not (self.use_ep or self.use_pp)):
             raise ValueError("MoE + pure tensor parallelism not supported: "
-                             "use data=N,expert=M[,model=K]")
+                             "use data=N,expert=M[,model=K] or "
+                             "data=N,stage=S,model=K")
         if cfg.fsdp and (self.use_sp or self.use_tp or self.use_ep):
             self.log("warning: fsdp applies to the pure data-parallel "
                      "layout; ignored with a seq/model/expert mesh axis")
@@ -365,23 +360,15 @@ class LMTrainer:
             if cfg.pp_schedule not in ("gpipe", "1f1b"):
                 raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r} "
                                  "(gpipe|1f1b)")
-            if cfg.loss_chunk and cfg.pp_schedule == "1f1b":
-                self.log("warning: --loss-chunk applies to the gpipe "
-                         "schedule (1f1b keeps its per-stage head vjp) "
-                         "— ignored")
-            if cfg.pp_schedule == "1f1b":
-                self.train_step = make_lm_pp_1f1b_train_step(
-                    self.model, self.tx, self.mesh,
-                    cfg.pp_microbatches)
-            else:
-                self.train_step = make_lm_pp_train_step(
-                    self.model, self.tx, self.mesh,
-                    cfg.pp_microbatches, loss_chunk=cfg.loss_chunk,
-                    aux_weight=cfg.moe_aux_weight)
+            maker = (make_lm_pp_1f1b_train_step
+                     if cfg.pp_schedule == "1f1b" else make_lm_pp_train_step)
+            self.train_step = maker(
+                self.model, self.tx, self.mesh, cfg.pp_microbatches,
+                loss_chunk=cfg.loss_chunk, aux_weight=cfg.moe_aux_weight,
+                grad_clip=cfg.grad_clip)
             self.eval_step = make_lm_pp_eval_step(
                 self.model, self.mesh, cfg.pp_microbatches,
-                loss_chunk=(cfg.loss_chunk
-                            if cfg.pp_schedule == "gpipe" else 0))
+                loss_chunk=cfg.loss_chunk)
             self.data_spec = P("data", None)
             self.valid_spec = P("data")
         elif self.use_sp:

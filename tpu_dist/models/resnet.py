@@ -103,17 +103,27 @@ class ResNet(nn.Module):
     norm: str = "bn"  # bn = torchvision parity (SyncBN under jit);
                       # gn = GroupNorm(32): no running stats / batch coupling
                       # (identical math at any batch size or replica count)
+    norm_dtype: Any = None
+    # norm_dtype None = fp32 normalization OUTPUTS (torch parity: AMP keeps
+    # the BN->relu->residual chain fp32). jnp.bfloat16 emits bf16 normalized
+    # activations while BN/GN STATISTICS still accumulate in fp32 (flax
+    # computes mean/var in f32 internally, and running stats/affine params
+    # stay f32 param_dtype) — the MLPerf-TPU ResNet practice. The round-5
+    # profile (tools/profile_image.py, BASELINE.md) showed the training
+    # step HBM-bandwidth-bound with fp32 activation/cotangent tensors
+    # between every bf16 conv; bf16 norm outputs halve that traffic.
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        ndt = self.norm_dtype or jnp.float32
         if self.norm == "gn":
             norm = partial(nn.GroupNorm, num_groups=32, epsilon=1e-5,
-                           dtype=jnp.float32)
+                           dtype=ndt)
         elif self.norm == "bn":
             norm = partial(nn.BatchNorm, use_running_average=not train,
                            momentum=0.9, epsilon=1e-5,
-                           dtype=jnp.float32)  # stats & affine in fp32
+                           dtype=ndt)  # stats & affine always fp32
         else:
             raise ValueError(f"unknown norm {self.norm!r} (bn|gn)")
 
