@@ -302,9 +302,18 @@ def main():
         kwargs["cifar_stem"] = True
     if os.environ.get("BENCH_NORM") and os.environ["BENCH_NORM"] != "bn":
         kwargs["norm"] = os.environ["BENCH_NORM"]  # bn/empty = default
+    norm_dtype = os.environ.get("BENCH_NORM_DTYPE")
+    if norm_dtype:
+        if norm_dtype not in ("bf16", "fp32"):
+            raise SystemExit(f"BENCH_NORM_DTYPE={norm_dtype}: use bf16 "
+                             "(fp32-stats/bf16-activations) or fp32")
+        if norm_dtype == "bf16":
+            import jax.numpy as jnp
+            kwargs["norm_dtype"] = jnp.bfloat16
     if kwargs and not ARCH.startswith("resnet"):
-        raise SystemExit(f"BENCH_CIFAR_STEM/BENCH_NORM are ResNet knobs; "
-                         f"unset them with BENCH_ARCH={ARCH}")
+        raise SystemExit(
+            "BENCH_CIFAR_STEM/BENCH_NORM/BENCH_NORM_DTYPE are ResNet "
+            f"knobs; unset them with BENCH_ARCH={ARCH}")
     best, rates, window_flops, batch = measure(
         kwargs, per_chip_batch, k, trials)
     ips_per_chip, tflops, mfu, fpi = report("headline", best, rates,
