@@ -135,3 +135,63 @@ def test_generate_zero_steps_returns_prompt():
     for use_cache in (False, True):
         out = generate(model, params, prompt, 0, use_cache=use_cache)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+
+def test_mesh_data_sharded_decode_matches_single_device():
+    """Batch-sharded decode over a ('data',) mesh emits the SAME greedy
+    tokens as single-device decode, both full-recompute and KV-cache paths
+    (VERDICT r4 #3: sharded inference must be bit-identical on tokens)."""
+    lm, params = _lm_and_params(seed=11)
+    mesh = make_mesh((8,), ("data",))
+    prompt = jnp.tile(jnp.asarray([[1, 5, 9, 2]], jnp.int32), (8, 1))
+    prompt = prompt.at[:, 0].set(jnp.arange(8))  # distinct rows per shard
+    single = generate(lm, params, prompt, steps=10)
+    for use_cache in (False, True):
+        sharded = generate(lm, params, prompt, steps=10, mesh=mesh,
+                           use_cache=use_cache)
+        np.testing.assert_array_equal(np.asarray(single), np.asarray(sharded))
+
+
+def test_mesh_tp_decode_matches_single_device():
+    """TP decode (heads + vocab sharded over 'model') matches single-device
+    greedy tokens; KV cache shards its heads axis."""
+    lm, params = _lm_and_params(seed=12)
+    mesh = make_mesh((4,), ("model",), devices=jax.devices()[:4])
+    prompt = jnp.asarray([[3, 7, 1, 4], [2, 2, 9, 9]], jnp.int32)
+    single = generate(lm, params, prompt, steps=10)
+    for use_cache in (False, True):
+        tp = generate(lm, params, prompt, steps=10, mesh=mesh,
+                      use_cache=use_cache)
+        np.testing.assert_array_equal(np.asarray(single), np.asarray(tp))
+
+
+def test_mesh_dp_tp_decode_matches_single_device():
+    """2-D ('data','model') decode: batch AND heads sharded together."""
+    lm, params = _lm_and_params(seed=13)
+    mesh = make_mesh((2, 4), ("data", "model"))
+    prompt = jnp.asarray([[3, 7, 1, 4], [8, 2, 9, 9]], jnp.int32)
+    single = generate(lm, params, prompt, steps=8, use_cache=True)
+    both = generate(lm, params, prompt, steps=8, use_cache=True, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(both))
+
+
+def test_mesh_tp_decode_rejects_indivisible_heads():
+    import pytest
+    lm, params = _lm_and_params(seed=14)  # tiny_lm: 4 heads
+    mesh = make_mesh((8,), ("model",))
+    with pytest.raises(ValueError, match="num_heads"):
+        generate(lm, params, jnp.ones((1, 4), jnp.int32), steps=4, mesh=mesh)
+
+
+def test_mesh_sampled_decode_reproduces_replicated_rng():
+    """temperature>0 under a data mesh: the rng is replicated, so sampling
+    is still deterministic given the key, and matches single-device."""
+    lm, params = _lm_and_params(seed=15)
+    mesh = make_mesh((8,), ("data",))
+    prompt = jnp.tile(jnp.asarray([[6, 1, 3, 8]], jnp.int32), (8, 1))
+    key = jax.random.PRNGKey(7)
+    single = generate(lm, params, prompt, steps=8, temperature=0.7, rng=key,
+                      use_cache=True)
+    sharded = generate(lm, params, prompt, steps=8, temperature=0.7, rng=key,
+                       use_cache=True, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(sharded))

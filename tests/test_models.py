@@ -224,3 +224,40 @@ def test_vit_trains_via_trainer(tmp_path):
     tr = Trainer(cfg)
     acc = tr.fit()
     assert acc >= 0.5, acc  # learnable synthetic set separates quickly
+
+
+def test_s2d_stem_spans_imagenet_stem():
+    """The space-to-depth stem (stem='s2d') computes EXACTLY the imagenet
+    7x7/s2 stem's function when its 4x4x12 kernel is the reindexed 7x7x3
+    kernel: pad the 7x7 taps to 8x8, split tap (i,j) into (2a+u, 2b+v), and
+    place w[2a+u,2b+v,c] at s2d-kernel position [a,b, u*2C+v*C+c]. Same
+    per-image outputs => the s2d bench variant is the same model family,
+    not a different workload (MLPerf-TPU ResNet equivalence)."""
+    from tpu_dist.models import create_model
+
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+
+    ref = create_model("resnet18", num_classes=10)
+    v_ref = ref.init(rng, x, train=False)
+
+    s2d = create_model("resnet18", num_classes=10, stem="s2d")
+    v_s2d = s2d.init(rng, x, train=False)
+
+    w = v_ref["params"]["conv1"]["kernel"]            # (7, 7, 3, 64)
+    w_pad = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))  # -> (8, 8, 3, 64)
+    c = w.shape[2]
+    # (2a+u, 2b+v, ch, co) -> (a, b, u*2c + v*c + ch, co)
+    w2 = (w_pad.reshape(4, 2, 4, 2, c, 64)            # (a, u, b, v, c, co)
+          .transpose(0, 2, 1, 3, 4, 5)                # (a, b, u, v, c, co)
+          .reshape(4, 4, 4 * c, 64))
+    assert v_s2d["params"]["conv1"]["kernel"].shape == w2.shape
+
+    import flax
+    params = flax.core.unfreeze(v_ref["params"])
+    params["conv1"] = {"kernel": w2}
+    out_ref = ref.apply(v_ref, x, train=False)
+    out_s2d = s2d.apply({"params": params,
+                         "batch_stats": v_ref["batch_stats"]}, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_ref), np.asarray(out_s2d),
+                               atol=1e-4, rtol=1e-4)

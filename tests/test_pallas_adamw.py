@@ -1,0 +1,91 @@
+"""Pallas fused AdamW kernel: exact optax.adamw numerics (interpret mode)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist.ops.pallas_adamw import FusedAdamW, fused_adamw_leaf
+
+
+def _scalars(lr, b1, b2, eps, wd, t):
+    return jnp.asarray([[lr, b1, b2, eps, wd,
+                         1.0 - b1 ** t, 1.0 - b2 ** t, 0.0]], jnp.float32)
+
+
+@pytest.mark.parametrize("shape", [(7,), (130,), (3, 3, 16, 32)])
+def test_fused_leaf_matches_reference_math(shape):
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    g = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    m = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    v = jnp.asarray(rng.uniform(0.01, 1.0, size=shape), jnp.float32)
+    lr, b1, b2, eps, wd, t = 0.1, 0.9, 0.95, 1e-8, 0.1, 3
+    p2, m2, v2 = fused_adamw_leaf(p, g, m, v,
+                                  _scalars(lr, b1, b2, eps, wd, t),
+                                  interpret=True)
+    m_ref = b1 * m + (1 - b1) * g
+    v_ref = b2 * v + (1 - b2) * g * g
+    mhat = m_ref / (1 - b1 ** t)
+    vhat = v_ref / (1 - b2 ** t)
+    p_ref = p - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * p)
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(p_ref),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(m_ref),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(v2), np.asarray(v_ref),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_fused_adamw_matches_optax_over_steps():
+    """Multi-step trajectory equality with optax.adamw (the engine's adamw)
+    over a small param tree, including bias-correction warmup steps."""
+    from tpu_dist.ops.optim import make_optimizer
+
+    rng = np.random.default_rng(1)
+    params = {"w": jnp.asarray(rng.normal(size=(40, 9)), jnp.float32),
+              "b": jnp.asarray(rng.normal(size=(9,)), jnp.float32)}
+    sched = lambda s: 0.05
+    tx_ref = make_optimizer(0.05, weight_decay=0.1, kind="adamw",
+                            schedule=sched, b1=0.9, b2=0.95, eps=1e-8)
+    tx_fused = FusedAdamW(sched, b1=0.9, b2=0.95, eps=1e-8,
+                          weight_decay=0.1, interpret=True)
+    p_ref, o_ref = params, tx_ref.init(params)
+    p_f, o_f = params, tx_fused.init(params)
+    for step in range(4):
+        g = jax.tree.map(
+            lambda p: jnp.asarray(rng.normal(size=p.shape), jnp.float32),
+            params)
+        upd, o_ref = tx_ref.update(g, o_ref, p_ref)
+        p_ref = jax.tree.map(lambda p, u: p + u, p_ref, upd)
+        p_f, o_f = tx_fused.apply(p_f, g, o_f, jnp.int32(step))
+        for k in params:
+            np.testing.assert_allclose(np.asarray(p_f[k]),
+                                       np.asarray(p_ref[k]),
+                                       rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_lm_trainer_with_fused_adamw_converges():
+    """LMTrainer --optimizer fused_adamw end-to-end: perplexity drops on
+    the learnable synthetic corpus (the engine dispatches on the apply()
+    protocol — same plumbing as image fused_sgd)."""
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    kw = dict(vocab_size=64, seq_len=32, d_model=32, num_layers=1,
+              num_heads=2, batch_size=16, epochs=2, synth_tokens=4096,
+              lr=2e-2, seed=0, print_freq=200)
+    ppl = LMTrainer(LMConfig(optimizer="fused_adamw", **kw)).fit()
+    assert ppl < 40, ppl  # vocab 64: uniform would be 64
+
+
+def test_fused_adamw_rejects_grad_clip_outside_pp():
+    import pytest as _pytest
+
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    with _pytest.raises(ValueError, match="grad-clip"):
+        LMTrainer(LMConfig(optimizer="fused_adamw", grad_clip=1.0,
+                           vocab_size=64, seq_len=32, d_model=32,
+                           num_layers=1, num_heads=2, batch_size=16))

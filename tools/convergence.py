@@ -41,6 +41,12 @@ def main():
     ap.add_argument("--threshold", type=float, default=0.90)
     ap.add_argument("--max-epochs", type=int, default=20)
     ap.add_argument("--steps-per-dispatch", type=int, default=1)
+    ap.add_argument("--norm-dtype", default="",
+                    help="'' (fp32 norm outputs) | bf16 (MLPerf-TPU "
+                         "practice) — accuracy-parity check for the bench's "
+                         "norm_dtype lever")
+    ap.add_argument("--stem", default="",
+                    help="imagenet | cifar | s2d (space-to-depth)")
     args = ap.parse_args()
 
     import jax
@@ -55,6 +61,7 @@ def main():
         synth_val_size=args.synth_val_size, lr=args.lr, seed=args.seed,
         epochs=args.max_epochs, print_freq=10 ** 9,
         steps_per_dispatch=args.steps_per_dispatch,
+        norm_dtype=args.norm_dtype, stem=args.stem,
         checkpoint_dir=os.path.join("/tmp", "convergence_ck"))
     tr = Trainer(cfg)
 
@@ -80,6 +87,7 @@ def main():
                "variant": args.variant, "precision": args.precision,
                "arch": args.arch, "batch_size": args.batch_size,
                "train_size": args.synth_train_size, "seed": args.seed,
+               "norm_dtype": args.norm_dtype or "fp32", "stem": args.stem,
                **(result or {"steps_to_threshold": None,
                              "note": f"not reached in {cfg.epochs} epochs"})}
         print(json.dumps(out))

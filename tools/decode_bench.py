@@ -34,6 +34,16 @@ def main():
     ap.add_argument("--num-heads", type=int, default=8)
     ap.add_argument("--precision", default="bf16", choices=["fp32", "bf16"])
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--top-p", type=float, default=0.0)
+    ap.add_argument("--tp", type=int, default=0,
+                    help="decode over a ('model',) mesh of this many devices "
+                         "(Megatron head/vocab sharding + heads-sharded KV "
+                         "cache; engine.generate mesh path). 0 = no mesh. "
+                         "The decode tick is weight-bandwidth-bound, so TP "
+                         "cuts ms/token ~linearly when devices exist.")
+    ap.add_argument("--dp", type=int, default=0,
+                    help="decode over a ('data',) mesh: batch-sharded")
     ap.add_argument("--skip-full", action="store_true",
                     help="skip the O(L^2) full-recompute reference "
                          "(slow at long totals)")
@@ -60,6 +70,24 @@ def main():
         rng.integers(0, args.vocab_size, (args.batch, args.prompt_len)),
         jnp.int32)
 
+    mesh = None
+    if args.tp or args.dp:
+        from tpu_dist.parallel.mesh import make_mesh
+        if args.dp and args.batch % args.dp:
+            # generate() would silently fall back to a replicated buffer
+            # and the JSON would claim a dp run that never happened
+            raise SystemExit(f"--dp {args.dp} needs --batch divisible by it "
+                             f"(got {args.batch})")
+        if args.tp and args.dp:
+            mesh = make_mesh((args.dp, args.tp), ("data", "model"),
+                             devices=jax.devices()[:args.dp * args.tp])
+        elif args.tp:
+            mesh = make_mesh((args.tp,), ("model",),
+                             devices=jax.devices()[:args.tp])
+        else:
+            mesh = make_mesh((args.dp,), ("data",),
+                             devices=jax.devices()[:args.dp])
+
     def timed(use_cache):
         # completion forced with a device_get readback — block_until_ready
         # does not reliably block across tunneled controllers (same caveat
@@ -68,13 +96,15 @@ def main():
         # one-token ticks; the full path runs exactly `steps` full forwards.
         ticks = args.steps
         out = generate(model, params, prompt, args.steps,
-                       temperature=args.temperature, use_cache=use_cache)
+                       temperature=args.temperature, use_cache=use_cache,
+                       top_k=args.top_k, top_p=args.top_p, mesh=mesh)
         jax.device_get(out)                             # compile + warm
         best = float("inf")
         for _ in range(args.trials):
             t0 = time.perf_counter()
             out = generate(model, params, prompt, args.steps,
-                           temperature=args.temperature, use_cache=use_cache)
+                           temperature=args.temperature, use_cache=use_cache,
+                           top_k=args.top_k, top_p=args.top_p, mesh=mesh)
             jax.device_get(out)
             best = min(best, time.perf_counter() - t0)
         toks = args.batch * args.steps
@@ -110,6 +140,8 @@ def main():
         "steps": args.steps, "layers": args.num_layers,
         "d_model": args.d_model, "vocab": args.vocab_size,
         "precision": args.precision,
+        "temperature": args.temperature, "top_k": args.top_k,
+        "top_p": args.top_p, "tp": args.tp, "dp": args.dp,
     }))
 
 

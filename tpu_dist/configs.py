@@ -31,7 +31,18 @@ class TrainConfig:
 
     # -- model (reference 1.dataparallel.py:32-38)
     arch: str = "resnet18"
-    pretrained: bool = False
+    pretrained: str = ""               # reference: bool (download torchvision
+    # weights). Zero egress makes that a PATH: warm-start params/BN stats
+    # from a local checkpoint (this repo's own model_best format), fresh
+    # optimizer state — shape-mismatched leaves (a different-class head)
+    # keep their init, the fine-tune contract. "" = train from scratch.
+    norm: str = ""                     # ResNet-only: bn (default) | gn
+    norm_dtype: str = ""               # ResNet-only: "" (fp32 norm outputs,
+                                       # torch-AMP parity) | bf16 (MLPerf-TPU
+                                       # practice: bf16 normalized activations,
+                                       # fp32 statistics — models/resnet.py)
+    stem: str = ""                     # ResNet-only: imagenet | cifar | s2d
+                                       # (space-to-depth, models/resnet.py)
 
     # -- schedule (reference 1.dataparallel.py:39-56)
     epochs: int = 10
@@ -92,6 +103,9 @@ class TrainConfig:
     # -- observability (reference C21/C22)
     log_csv: str = ""                  # per-epoch [start, seconds] CSV if set
     profile_dir: str = ""              # jax.profiler trace dir if set
+    telemetry_csv: str = ""            # 500ms device-HBM/host-RSS sampler CSV
+                                       # (utils.telemetry — the reference's
+                                       # nvidia-smi statistics.sh analog)
 
     # -- synthetic-data knobs (TPU-only: zero-egress envs can't download datasets)
     synth_train_size: int = 50000
@@ -189,9 +203,12 @@ class LMConfig:
     evaluate: bool = False
     seed: Optional[int] = 0
     resume: str = ""
+    pretrained: str = ""           # warm-start params from a local ckpt
+                                   # (fresh opt state; see TrainConfig)
     checkpoint_dir: str = ""
     log_csv: str = ""
     profile_dir: str = ""          # jax.profiler trace dir if set (C22)
+    telemetry_csv: str = ""        # 500ms device-HBM sampler (utils.telemetry)
 
 
 def add_args(parser: argparse.ArgumentParser, defaults) -> None:

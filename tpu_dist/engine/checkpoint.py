@@ -63,6 +63,18 @@ _to_host = gather_to_host  # internal alias
 _MAGIC = b"TPUDIST1\n"
 
 
+def _split_container(raw: bytes) -> Tuple[Dict, Any]:
+    """(meta, blob_view) from container bytes — THE header parse, shared by
+    every reader. Pre-container files (bare msgpack) return ({}, raw)."""
+    if not raw.startswith(_MAGIC):
+        return {}, raw
+    off = len(_MAGIC)
+    meta_len = int.from_bytes(raw[off:off + 8], "little")
+    meta = json.loads(raw[off + 8:off + 8 + meta_len])
+    # memoryview: don't hold a second full copy of a multi-GB state
+    return meta, memoryview(raw)[off + 8 + meta_len:]
+
+
 def _write(ckpt_dir: str, path: str, host_state, meta: Dict,
            arch: str, is_best: bool) -> None:
     meta_bytes = json.dumps(meta).encode()
@@ -159,7 +171,10 @@ def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
 
 def read_checkpoint_meta(path: str) -> Dict:
     """Metadata only, without deserializing the blob — validate geometry
-    BEFORE from_bytes (whose structure-mismatch errors are opaque)."""
+    BEFORE from_bytes (whose structure-mismatch errors are opaque).
+
+    Reads just the header (same layout _split_container parses), never the
+    multi-GB blob."""
     with open(path, "rb") as f:
         head = f.read(len(_MAGIC) + 8)
         if head.startswith(_MAGIC):
@@ -171,21 +186,70 @@ def read_checkpoint_meta(path: str) -> Dict:
     return {}
 
 
+def load_warmstart(path: str) -> Tuple[Dict, Dict, Dict]:
+    """(params, batch_stats, meta) from a checkpoint WITHOUT a template.
+
+    The ``--pretrained PATH`` path (reference 1.dataparallel.py:97-102 loads
+    torchvision weights; zero egress means local files are the weight
+    source here — e.g. this repo's own ``{arch}-model_best.msgpack``).
+    Restores the raw msgpack state dict, so it needs no TrainState template
+    and carries no optimizer state — warm-starts always begin a FRESH
+    trajectory (fresh opt state, step 0), unlike --resume.
+    """
+    with open(path, "rb") as f:
+        raw = f.read()
+    meta, blob = _split_container(raw)
+    # msgpack_restore takes any buffer — no bytes() copy of a multi-GB blob
+    tree = serialization.msgpack_restore(blob)
+    return tree.get("params", {}), tree.get("batch_stats", {}) or {}, meta
+
+
+def graft_params(fresh, loaded, cast_dtype: bool = True):
+    """Overlay ``loaded`` leaves onto ``fresh`` where path AND shape match.
+
+    Returns (grafted_tree, n_loaded, skipped_paths). Mismatched or missing
+    leaves keep their fresh init — that is the fine-tune contract: a
+    checkpoint trained at num_classes=1000 warm-starts a 10-class model
+    with every tensor except the classifier head. Loaded leaves cast to the
+    fresh leaf's dtype (the storage-policy dtype of THIS run)."""
+    from flax import traverse_util
+
+    flat_f = traverse_util.flatten_dict(fresh)
+    flat_l = traverse_util.flatten_dict(loaded)
+    out, skipped, n = {}, [], 0
+    for k, v in flat_f.items():
+        lv = flat_l.get(k)
+        if lv is not None and getattr(lv, "shape", None) == v.shape:
+            out[k] = np.asarray(lv, dtype=v.dtype) if cast_dtype else lv
+            n += 1
+        else:
+            out[k] = v
+            skipped.append("/".join(map(str, k)))
+    return traverse_util.unflatten_dict(out), n, skipped
+
+
 def load_checkpoint(path: str, template_state) -> Tuple[Any, Dict]:
     """Restore a TrainState saved by save_checkpoint into template's structure."""
     with open(path, "rb") as f:
         raw = f.read()
-    meta: Dict = {}
-    if raw.startswith(_MAGIC):
-        off = len(_MAGIC)
-        meta_len = int.from_bytes(raw[off:off + 8], "little")
-        meta = json.loads(raw[off + 8:off + 8 + meta_len])
-        # memoryview: don't hold a second full copy of a multi-GB state
-        blob = memoryview(raw)[off + 8 + meta_len:]
-    else:  # pre-container checkpoint: bare msgpack + sidecar json
-        blob = raw
-        if os.path.exists(path + ".json"):
-            with open(path + ".json") as f:
-                meta = json.load(f)
-    state = serialization.from_bytes(template_state, blob)
+    meta, blob = _split_container(raw)
+    if not meta and os.path.exists(path + ".json"):
+        # pre-container checkpoint: bare msgpack + sidecar json
+        with open(path + ".json") as f:
+            meta = json.load(f)
+    try:
+        state = serialization.from_bytes(template_state, blob)
+    except (ValueError, KeyError) as e:
+        # The opt_state pytree is part of the serialized structure, so any
+        # flag that changes the optax chain between save and resume —
+        # --grad-clip on<->off (inserts/removes clip_by_global_norm state),
+        # --optimizer sgd<->adamw, --weight-decay 0<->nonzero — makes
+        # from_bytes fail with an opaque structure mismatch (ADVICE r4).
+        raise ValueError(
+            f"checkpoint {path!r} does not match the current run's state "
+            "structure. Common causes: a different model geometry, a "
+            "truncated/corrupt file, or optimizer-chain flags that differ "
+            "from the run that wrote it (--grad-clip on<->off inserts/"
+            "removes clip state; --optimizer; --weight-decay 0<->nonzero). "
+            f"Original error: {e}") from e
     return state, meta

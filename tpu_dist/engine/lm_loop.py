@@ -54,12 +54,15 @@ class LMTrainer:
         self.cfg = cfg
         if cfg.resume and not os.path.exists(cfg.resume):
             raise FileNotFoundError(f"--resume checkpoint not found: {cfg.resume}")
-        if cfg.optimizer not in ("sgd", "adamw"):
+        if cfg.pretrained and not os.path.exists(cfg.pretrained):
+            raise FileNotFoundError(
+                f"--pretrained checkpoint not found: {cfg.pretrained}")
+        if cfg.optimizer not in ("sgd", "adamw", "fused_adamw"):
             # fail fast, BEFORE corpus/model setup (the image Trainer's
             # contract; fused_sgd is image-only — its Pallas kernel assumes
             # the SGD update form)
             raise ValueError(f"unknown optimizer {cfg.optimizer!r} "
-                             "(sgd|adamw)")
+                             "(sgd|adamw|fused_adamw)")
         mesh_shape = cfg.mesh_shape or (jax.device_count(),)
         self.mesh = mesh if mesh is not None else make_mesh(
             tuple(mesh_shape), tuple(cfg.mesh_axes))
@@ -111,6 +114,22 @@ class LMTrainer:
         params = self.model.init(
             {"params": jax.random.PRNGKey(seed)},
             np.zeros((1, cfg.seq_len), np.int32), train=False)["params"]
+        if cfg.pretrained:
+            # warm-start BEFORE any pipeline stacking: the donor is a
+            # single-trajectory (non-pp-stacked) checkpoint — the format
+            # every mode here saves after gather (shape-matched graft,
+            # fresh optimizer state; --resume is the continue-a-run path;
+            # existence checked first-line in __init__)
+            pre_params, _, pre_meta = ckpt.load_warmstart(cfg.pretrained)
+            params, n_p, skipped = ckpt.graft_params(params, pre_params)
+            if n_p == 0:
+                raise ValueError(
+                    f"--pretrained {cfg.pretrained} (arch "
+                    f"{pre_meta.get('arch', '?')!r}) shares no tensors with "
+                    f"this model — wrong checkpoint?")
+            self.log(f"=> warm-started {n_p} param tensors from "
+                     f"{cfg.pretrained}"
+                     + (f"; fresh init kept for {skipped}" if skipped else ""))
         self.steps_per_epoch = max(
             1, -(-len(self.train_ds) // cfg.batch_size))
         # warmup + constant/cosine/step LR as a pure function of the step
@@ -126,12 +145,28 @@ class LMTrainer:
         # (parallel.pp._clip_pp_grads), so its optax chain carries no clip
         # of its own — which also keeps the opt_state pytree structure
         # independent of the --grad-clip flag under pp
-        self.tx = make_optimizer(cfg.lr, cfg.momentum, cfg.weight_decay,
-                                 schedule=self.lr_schedule,
-                                 kind=cfg.optimizer, b1=cfg.adam_b1,
+        if cfg.optimizer == "fused_adamw":
+            # Pallas fused update (ops.pallas_adamw): engine steps dispatch
+            # on the apply() protocol, pp included (pp clips grads BEFORE
+            # _apply_update, so grad_clip composes there); the non-pp clip
+            # lives in the optax chain this path doesn't have
+            if cfg.grad_clip > 0 and not self.use_pp:
+                raise ValueError(
+                    "--grad-clip with fused_adamw is only available under "
+                    "pipeline parallelism (the pp step clips before the "
+                    "fused update); use --optimizer adamw otherwise")
+            from tpu_dist.ops.pallas_adamw import FusedAdamW
+            self.tx = FusedAdamW(self.lr_schedule, b1=cfg.adam_b1,
                                  b2=cfg.adam_b2, eps=cfg.adam_eps,
-                                 grad_clip=0.0 if self.use_pp
-                                 else cfg.grad_clip)
+                                 weight_decay=cfg.weight_decay,
+                                 interpret=jax.default_backend() == "cpu")
+        else:
+            self.tx = make_optimizer(cfg.lr, cfg.momentum, cfg.weight_decay,
+                                     schedule=self.lr_schedule,
+                                     kind=cfg.optimizer, b1=cfg.adam_b1,
+                                     b2=cfg.adam_b2, eps=cfg.adam_eps,
+                                     grad_clip=0.0 if self.use_pp
+                                     else cfg.grad_clip)
         if self.use_pp:
             from tpu_dist.parallel.pp import stack_pipeline_params
             params = stack_pipeline_params(params, shape["stage"])
@@ -503,6 +538,11 @@ class LMTrainer:
         end = time.time()
         for i, inputs_d, targets_d in stream_prefetch(batches()):
             meters.update("Data", time.time() - end)
+            if getattr(self, "_program_hbm", None) is None:
+                from tpu_dist.utils.telemetry import program_hbm_bytes
+                self._program_hbm = program_hbm_bytes(
+                    self.train_step, self.state, inputs_d, targets_d,
+                    self.rng) or False  # False = probed, unavailable
             self.state, metrics = self.train_step(
                 self.state, inputs_d, targets_d, self.rng)
             if not self._warmed:
@@ -569,6 +609,11 @@ class LMTrainer:
         end = time.time()
         for n, idx_dev in windows:
             meters.update("Data", (time.time() - end) / n, n)
+            if getattr(self, "_program_hbm", None) is None:
+                from tpu_dist.utils.telemetry import program_hbm_bytes
+                self._program_hbm = program_hbm_bytes(
+                    self.window_step, self.state, self._train_rows_dev,
+                    idx_dev, self.rng) or False  # False = probed, unavailable
             self.state, metrics = self.window_step(
                 self.state, self._train_rows_dev, idx_dev, self.rng)
             if not self._warmed:
@@ -690,6 +735,10 @@ class LMTrainer:
             # the same C22 telemetry hook the image Trainer has
             import jax.profiler
             jax.profiler.start_trace(cfg.profile_dir)
+        stop_telemetry = None
+        if cfg.telemetry_csv and self.is_main:
+            from tpu_dist.utils.telemetry import start_hbm_sampler
+            stop_telemetry = start_hbm_sampler(cfg.telemetry_csv)
         try:
             self._fit_epochs()
         except KeyboardInterrupt:
@@ -706,6 +755,8 @@ class LMTrainer:
                 self.log("interrupted — no checkpoint_dir, nothing saved")
             raise
         finally:
+            if stop_telemetry is not None:
+                stop_telemetry()
             ckpt.wait_for_async_save()
             if profiling:
                 # flush the trace even on OOM/interrupt — a failing run is
@@ -740,8 +791,12 @@ class LMTrainer:
             is_best = ppl < self.best_ppl
             self.best_ppl = min(ppl, self.best_ppl)
             if cfg.log_csv and self.is_main:
+                from tpu_dist.utils.telemetry import peak_hbm_bytes
                 with open(cfg.log_csv, "a+", newline="") as f:
-                    csv.writer(f).writerow([t0, epoch_secs, round(tok_s, 1)])
+                    csv.writer(f).writerow(
+                        [t0, epoch_secs, round(tok_s, 1),
+                         peak_hbm_bytes()
+                         or getattr(self, "_program_hbm", None) or ""])
             if cfg.checkpoint_dir:
                 ckpt.save_checkpoint(
                     cfg.checkpoint_dir, self.state, epoch + 1, 0.0, "lm",

@@ -23,6 +23,7 @@ from functools import partial
 from typing import Callable, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -53,6 +54,9 @@ class Trainer:
         # fail fast on bad config, before device/model setup
         if cfg.resume and not os.path.exists(cfg.resume):
             raise FileNotFoundError(f"--resume checkpoint not found: {cfg.resume}")
+        if cfg.pretrained and not os.path.exists(cfg.pretrained):
+            raise FileNotFoundError(
+                f"--pretrained checkpoint not found: {cfg.pretrained}")
         if cfg.optimizer not in ("sgd", "fused_sgd", "adamw"):
             raise ValueError(f"unknown optimizer {cfg.optimizer!r} "
                              "(sgd|fused_sgd|adamw)")
@@ -82,9 +86,26 @@ class Trainer:
                 f"{ndev} ({nprocs} processes x {ndev // nprocs} local devices)")
         self.local_batch = cfg.batch_size // nprocs
 
+        model_kw = {}
+        if cfg.norm:
+            model_kw["norm"] = cfg.norm
+        if cfg.norm_dtype:
+            if cfg.norm_dtype not in ("bf16", "fp32"):
+                raise ValueError(f"--norm-dtype {cfg.norm_dtype!r} "
+                                 "(bf16|fp32)")
+            if cfg.norm_dtype == "bf16":
+                model_kw["norm_dtype"] = jnp.bfloat16
+        if cfg.stem:
+            model_kw["stem"] = cfg.stem
+        if model_kw and not cfg.arch.startswith(("resnet", "resnext",
+                                                 "wide_resnet")):
+            raise ValueError(
+                f"--norm/--norm-dtype/--stem are ResNet-family knobs; "
+                f"arch {cfg.arch!r} does not take them")
         self.model = create_model(
             cfg.arch, num_classes=self.num_classes,
-            dtype=self.policy.compute_dtype, pretrained=cfg.pretrained)
+            dtype=self.policy.compute_dtype, pretrained=cfg.pretrained,
+            **model_kw)
 
         seed = cfg.seed if cfg.seed is not None else 0
         self.rng = jax.random.PRNGKey(seed)
@@ -92,6 +113,19 @@ class Trainer:
         params, batch_stats = init_model(
             self.model, self.rng, (2, h, w, c))
         params = self.policy.cast_params_for_storage(params)
+        if cfg.pretrained:  # existence checked first-line in __init__
+            pre_params, pre_stats, pre_meta = ckpt.load_warmstart(
+                cfg.pretrained)
+            params, n_p, skipped = ckpt.graft_params(params, pre_params)
+            batch_stats, n_s, _ = ckpt.graft_params(batch_stats, pre_stats)
+            if n_p == 0:
+                raise ValueError(
+                    f"--pretrained {cfg.pretrained} (arch "
+                    f"{pre_meta.get('arch', '?')!r}) shares no tensors with "
+                    f"{cfg.arch!r} — wrong checkpoint?")
+            self.log(f"=> warm-started {n_p} param tensors (+{n_s} BN stats)"
+                     f" from {cfg.pretrained}"
+                     + (f"; fresh init kept for {skipped}" if skipped else ""))
 
         # ceil: the sampler pads to full batches, so an epoch really runs
         # ceil(N/batch) optimizer steps — floor would fire LR decay early
@@ -308,7 +342,8 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def log(self, *a, **k):
-        if self.is_main:
+        # getattr: log is callable from __init__ before is_main is set
+        if getattr(self, "is_main", jax.process_index() == 0):
             print(*a, **k, flush=True)
 
     def _sampler(self, ds, train: bool, epoch: int) -> DistributedSampler:
@@ -369,6 +404,14 @@ class Trainer:
                 end = time.time()
                 continue
             meters.update("Data", time.time() - end)
+            if getattr(self, "_program_hbm", None) is None:
+                # static per-program peak (CSV column; lower() is abstract,
+                # so donation is untouched and post-warmup this is a cache
+                # hit — see utils.telemetry.program_hbm_bytes)
+                from tpu_dist.utils.telemetry import program_hbm_bytes
+                self._program_hbm = program_hbm_bytes(
+                    self.train_step, self.state, images, labels,
+                    self.rng) or False  # False = probed, unavailable
             self.state, metrics = self.train_step(
                 self.state, images, labels, self.rng)
             pending.append(metrics)
@@ -481,6 +524,13 @@ class Trainer:
             # printed avg keeps the per-batch path's meaning:
             # avg(Time) = wall / batches in both paths
             meters.update("Data", (time.time() - end) / n, n)
+            if getattr(self, "_program_hbm", None) is None:
+                from tpu_dist.utils.telemetry import program_hbm_bytes
+                args = ((*self._train_data_dev, dev_payload, self.rng)
+                        if self.device_data else (*dev_payload, self.rng))
+                self._program_hbm = program_hbm_bytes(
+                    self.window_step, self.state,
+                    *args) or False  # False = probed, unavailable
             self.state, metrics = dispatch(self.state, dev_payload)
             done += n
             pending.append(metrics)
@@ -550,6 +600,10 @@ class Trainer:
             import jax.profiler
             jax.profiler.start_trace(cfg.profile_dir)
         csv_path = cfg.log_csv or ""
+        stop_telemetry = None
+        if cfg.telemetry_csv and self.is_main:
+            from tpu_dist.utils.telemetry import start_hbm_sampler
+            stop_telemetry = start_hbm_sampler(cfg.telemetry_csv)
         try:
             self._fit_epochs(csv_path)
         except KeyboardInterrupt:
@@ -565,6 +619,8 @@ class Trainer:
                      f"{self._epoch_in_progress}; resume with --resume")
             raise
         finally:
+            if stop_telemetry is not None:
+                stop_telemetry()
             ckpt.wait_for_async_save()  # never exit with a write in flight
             if profiling:
                 # flush the trace even on OOM/interrupt — a failing run is
@@ -592,10 +648,18 @@ class Trainer:
             is_best = acc1 > self.best_acc1
             self.best_acc1 = max(acc1, self.best_acc1)
             if csv_path and self.is_main:
-                # reference CSV format [wall start, epoch seconds] + a third
-                # column: train-phase images/sec (tpu_dist extension)
+                # reference CSV format [wall start, epoch seconds] + tpu_dist
+                # extensions: train-phase images/sec and the allocator's
+                # peak-HBM high-water mark (VERDICT r4 #5; empty on backends
+                # without memory counters)
+                from tpu_dist.utils.telemetry import peak_hbm_bytes
                 with open(csv_path, "a+", newline="") as f:
-                    csv.writer(f).writerow([t0, epoch_secs, round(train_ips, 1)])
+                    csv.writer(f).writerow(
+                        [t0, epoch_secs, round(train_ips, 1),
+                         # allocator truth when the backend exposes it,
+                         # else XLA's static per-program analysis
+                         peak_hbm_bytes()
+                         or getattr(self, "_program_hbm", None) or ""])
             # async: serialization + disk write overlap the next epoch (the
             # device->host gather stays on the critical path by necessity)
             ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, epoch + 1,

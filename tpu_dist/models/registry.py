@@ -3,9 +3,11 @@
 The reference selects any lowercase callable from
 ``torchvision.models.__dict__`` by name (reference 1.dataparallel.py:23-24,
 97-102). tpu_dist keeps the same UX — ``create_model("resnet50")`` — over an
-explicit registry (no torchvision on TPU; ``--pretrained`` is accepted for CLI
-parity but there are no bundled weights in a zero-egress environment, so it
-raises a clear error instead of silently ignoring the flag).
+explicit registry (no torchvision on TPU). ``--pretrained`` takes a local
+checkpoint PATH to warm-start from (engine.checkpoint.load_warmstart /
+graft_params — fine-tune keeps fresh init for shape-mismatched heads);
+boolean True still raises a clear error because a zero-egress environment
+has no weights to download.
 
 Each entry carries its *kind* ("image" classifier vs "lm") so construction
 and engine dispatch stay in one place: image ctors take ``num_classes``, LM
@@ -86,11 +88,16 @@ def model_kind(arch: str) -> str:
 
 
 def create_model(arch: str, num_classes: int = 10, dtype=jnp.float32,
-                 pretrained: bool = False, **kwargs):
-    if pretrained:
+                 pretrained=False, **kwargs):
+    if pretrained is True:
         raise ValueError(
-            "--pretrained requires downloaded weights; this environment has no "
-            "egress. Train from scratch or point --resume at a checkpoint.")
+            "--pretrained without a path requires downloaded weights; this "
+            "environment has no egress. Pass --pretrained PATH (a local "
+            "checkpoint, e.g. an {arch}-model_best.msgpack from this repo) "
+            "to warm-start, or train from scratch.")
+    # a str path is handled by the engines (params live outside the module
+    # in jax — the factory only builds architecture), so it is accepted
+    # here for signature parity and acted on in Trainer/LMTrainer.
     kind = model_kind(arch)
     ctor = _REGISTRY[arch][0]
     if kind == "lm":

@@ -37,11 +37,14 @@ def _causal_mask(scores, q_pos, k_pos):
     return jnp.where(k_pos[None, :] <= q_pos[:, None], scores, NEG_INF)
 
 
+@functools.lru_cache(maxsize=None)
 def blockwise_attention_fn(block_size: int = 512):
     """Returns attn(q, k, v, causal=True, q_offset=0, kv_offset=0).
 
     Shapes follow the model convention: (B, L, H, D). fp32 softmax state
     regardless of input dtype, like tpu_dist.models.transformer.full_attention.
+    Memoized per config so identical-hyperparameter models (which carry
+    this closure as a hash field) compare equal — see ring_attention_fn.
     """
 
     def attn(q, k, v, *, causal: bool = True, q_offset=0, kv_offset=0):
@@ -409,6 +412,7 @@ def _fa_backward(q, k, v, out, lse, g, causal, q_offset, kv_offset,
     return unfold(dq, lq), unfold(dk, lk), unfold(dv, lk)
 
 
+@functools.lru_cache(maxsize=None)
 def flash_attention_fn(block_q: int = 1024, block_k: int | None = None,
                        interpret: bool | None = None,
                        recompute_block: int | None = None):

@@ -20,7 +20,7 @@ mesh's sequence axis. Use with models whose attention fn is pluggable
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Callable
 
 import jax
@@ -83,7 +83,13 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = True):
     return o.astype(q.dtype)
 
 
+@lru_cache(maxsize=None)
 def ring_attention_fn(axis_name: str = SEQ_AXIS,
                       causal: bool = True) -> Callable:
-    """attn_fn factory for TransformerLM: plugs the ring in for full_attention."""
+    """attn_fn factory for TransformerLM: plugs the ring in for full_attention.
+
+    Memoized so same-config calls return the SAME callable: flax modules
+    hash by field value, so a per-call closure here would make two
+    identical models compare unequal and defeat every module-keyed program
+    cache downstream (engine.generate memoization; ADVICE r4)."""
     return partial(ring_attention, axis_name=axis_name, causal=causal)

@@ -1,0 +1,100 @@
+"""Device-side telemetry (reference statistics.sh:1-4, the nvidia-smi analog).
+
+The reference samples GPU memory + utilization to CSV every 500 ms with
+nvidia-smi from a *separate process*. TPU device memory is only visible to
+the owning process (the XLA client), so the analog is in-process: a daemon
+thread samples ``device.memory_stats()`` — the runtime's live HBM counters
+(bytes_in_use / peak_bytes_in_use / bytes_limit) — at the same cadence,
+alongside host RSS. ``scripts/statistics.sh`` keeps the out-of-process host
+view; engines start this sampler when ``--telemetry-csv`` is set.
+
+CPU/virtual backends return no memory_stats; columns are left empty there so
+the same CSV schema works in tests.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+
+CSV_HEADER = "ts,hbm_bytes_in_use,hbm_peak_bytes,hbm_bytes_limit,host_rss_kb"
+
+
+def device_memory_stats(device: Optional[jax.Device] = None) -> dict:
+    """memory_stats() of the first addressable device; {} when the backend
+    does not expose counters (CPU, some virtual platforms)."""
+    dev = device or jax.local_devices()[0]
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        return {}
+    return stats or {}
+
+
+def peak_hbm_bytes(device: Optional[jax.Device] = None) -> Optional[int]:
+    """High-water HBM mark since process start (the per-epoch CSV column).
+
+    This is the allocator's own peak counter — it covers every compiled
+    program and live buffer, which is what an OOM postmortem needs; the
+    per-program view lives in compiled.memory_analysis() (tests/test_pp.py
+    uses it to pin 1F1B's O(S) activation flatness).
+    """
+    return device_memory_stats(device).get("peak_bytes_in_use")
+
+
+def program_hbm_bytes(jitted_fn, *args) -> Optional[int]:
+    """Static peak-HBM estimate of ONE compiled program from XLA's own
+    buffer assignment (compiled.memory_analysis()): arguments + outputs +
+    temps - donated aliases. Works on every backend — including tunneled
+    controllers where memory_stats() returns None — because it reads the
+    executable, not allocator counters. After the first dispatch the
+    lower/compile here is a cache hit, so calling it per epoch is cheap."""
+    try:
+        ma = jitted_fn.lower(*args).compile().memory_analysis()
+        return int(ma.argument_size_in_bytes + ma.output_size_in_bytes
+                   + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+    except Exception:
+        return None
+
+
+def _host_rss_kb() -> Optional[int]:
+    try:
+        with open(f"/proc/{os.getpid()}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return None
+
+
+def start_hbm_sampler(path: str, interval_s: float = 0.5) -> Callable[[], None]:
+    """Write `CSV_HEADER` rows to ``path`` every ``interval_s`` until the
+    returned stop() is called. Daemon thread: it never blocks exit."""
+    f = open(path, "w", buffering=1)
+    f.write(CSV_HEADER + "\n")
+    stop = threading.Event()
+
+    def run():
+        dev = jax.local_devices()[0]
+        while not stop.is_set():
+            s = device_memory_stats(dev)
+            row = (time.time(), s.get("bytes_in_use", ""),
+                   s.get("peak_bytes_in_use", ""), s.get("bytes_limit", ""),
+                   _host_rss_kb() or "")
+            f.write(",".join(str(x) for x in row) + "\n")
+            stop.wait(interval_s)
+        f.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+
+    def stop_fn():
+        stop.set()
+        t.join(timeout=5)
+
+    return stop_fn
