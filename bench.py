@@ -118,7 +118,18 @@ def lm_bench():
         remat=os.environ.get("BENCH_REMAT") == "1")
     params = model.init({"params": jax.random.PRNGKey(0)},
                         np.zeros((1, L), np.int32), train=False)["params"]
-    tx = make_optimizer(1e-3, 0.9, 0.0, steps_per_epoch=10 ** 6)
+    opt = os.environ.get("BENCH_OPTIMIZER", "sgd")
+    if opt == "fused_adamw":  # Pallas single-pass update (ops.pallas_adamw)
+        from tpu_dist.ops.pallas_adamw import FusedAdamW
+        tx = FusedAdamW(lambda s: 1e-3,
+                        interpret=jax.default_backend() != "tpu")
+    elif opt == "adamw":
+        tx = make_optimizer(1e-3, weight_decay=0.1, kind="adamw",
+                            schedule=lambda s: 1e-3)
+    elif opt == "sgd":
+        tx = make_optimizer(1e-3, 0.9, 0.0, steps_per_epoch=10 ** 6)
+    else:
+        raise SystemExit(f"BENCH_OPTIMIZER={opt}: sgd|adamw|fused_adamw")
     state = jax.device_put(TrainState.create(params, {}, tx),
                            replicated(mesh))
     window = make_lm_indexed_multi_train_step(model, tx, mesh,

@@ -1,8 +1,11 @@
 #!/bin/bash
 # TPU telemetry sampler (reference statistics.sh:1-4 nvidia-smi 500ms CSV).
-# No nvidia-smi on TPU; device utilization/memory come from the JAX profiler
-# (--profile-dir) — this script samples the TRAINING process's host RSS at the
-# same 500 ms cadence. Usage: statistics.sh <pid> [out.csv]; with no pid it
+# No nvidia-smi on TPU; device HBM is only visible to the owning XLA client,
+# so the in-process sampler (--telemetry-csv, tpu_dist/utils/telemetry.py)
+# records device bytes-in-use/peak/limit at the 500 ms cadence; this script
+# is the out-of-process companion, sampling the TRAINING process's host RSS
+# at the same cadence. Deeper device views: --profile-dir (XLA trace) and
+# the peak-HBM column in the per-epoch CSV. Usage: statistics.sh <pid> [out.csv]; with no pid it
 # samples the newest python process running a scripts/*.py entrypoint.
 # back-compat: `statistics.sh out.csv` (no pid) still works; with multiple
 # training processes (jax.distributed spawn) pass the rank-0 pid explicitly —

@@ -261,3 +261,31 @@ def test_s2d_stem_spans_imagenet_stem():
                          "batch_stats": v_ref["batch_stats"]}, x, train=False)
     np.testing.assert_allclose(np.asarray(out_ref), np.asarray(out_s2d),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_trainer_drives_norm_dtype_and_s2d_flags(tmp_path):
+    """--norm-dtype bf16 --stem s2d reach the model through TrainConfig
+    (the round-5 bench-default levers must be CLI-drivable, not bench-only)."""
+    from tpu_dist.configs import TrainConfig
+    from tpu_dist.engine import Trainer
+
+    cfg = TrainConfig(dataset="synthetic-cifar10", arch="resnet18",
+                      norm_dtype="bf16", stem="s2d", epochs=1,
+                      batch_size=64, synth_train_size=128, synth_val_size=64,
+                      seed=0, print_freq=100, checkpoint_dir=str(tmp_path))
+    tr = Trainer(cfg)
+    assert tr.model.stem == "s2d"
+    assert tr.model.norm_dtype == jnp.bfloat16
+    tr.fit()  # trains + validates end to end
+
+
+def test_trainer_rejects_resnet_knobs_on_other_archs():
+    import pytest as _pytest
+
+    from tpu_dist.configs import TrainConfig
+    from tpu_dist.engine import Trainer
+
+    with _pytest.raises(ValueError, match="ResNet-family"):
+        Trainer(TrainConfig(dataset="synthetic-mnist", arch="lenet",
+                            stem="s2d", batch_size=32,
+                            synth_train_size=64, synth_val_size=32))

@@ -51,6 +51,19 @@ def main():
     args = ap.parse_args()
 
     import jax
+
+    # honor JAX_PLATFORMS=cpu even when a sitecustomize pre-imported jax
+    # with a TPU plugin registered (env vars are read at import time;
+    # jax.config still works until a backend initializes — the same recipe
+    # as tests/conftest.py / parallel.launch.initialize)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        import re as _re
+        m = _re.search(r"host_platform_device_count=(\d+)",
+                       os.environ.get("XLA_FLAGS", ""))
+        if m:
+            jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+
     import jax.numpy as jnp
     import numpy as np
 

@@ -161,6 +161,11 @@ class LMConfig:
     batch_size: int = 16           # GLOBAL batch in sequences
     optimizer: str = "sgd"         # sgd | adamw (decoupled, b2=0.95 LM
                                    # convention — ops.optim.make_optimizer)
+                                   # | fused_adamw (Pallas single-pass
+                                   # kernel, ops.pallas_adamw; measured
+                                   # SLOWER than adamw at 0.9B — BASELINE.md
+                                   # round-5 — kept as the apex-FusedAdam
+                                   # capability analog)
     lr: float = 3e-2
     momentum: float = 0.9
     adam_b1: float = 0.9

@@ -94,14 +94,20 @@ def generate(model, params, prompt: jax.Array, steps: int,
             model, mesh, params, buf, rng)
 
     if use_cache:
-        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
-                             _cache_shapes(model, b, total))
         if mesh is not None:
-            cache = jax.device_put(cache, jax.tree.map(
-                lambda s: NamedSharding(
-                    mesh, P(data_ax, None, model_ax, None) if s.ndim == 4
-                    else P()),
-                cache))
+            # allocate each leaf DIRECTLY under its sharding — building the
+            # full replicated cache on one device first could OOM device 0
+            # at exactly the scales sharded decode exists for
+            cache = jax.tree.map(
+                lambda s: jnp.zeros(
+                    s.shape, s.dtype,
+                    device=NamedSharding(
+                        mesh, P(data_ax, None, model_ax, None)
+                        if len(s.shape) == 4 else P())),
+                _cache_shapes(model, b, total))
+        else:
+            cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                 _cache_shapes(model, b, total))
         decode = _cache_decode_program(model, b, p, total, temperature,
                                        top_k, top_p)
         return decode(params, cache, buf, rng)
@@ -121,7 +127,7 @@ def _shard_decode_inputs(model, mesh: Mesh, params, buf, rng):
     so a ('data',)-only mesh and a ('model',)-only mesh both just work.
     """
     from tpu_dist.parallel.mesh import DATA_AXIS, MODEL_AXIS
-    from tpu_dist.parallel.tp import lm_param_specs
+    from tpu_dist.parallel.tp import shard_lm_params
 
     b = buf.shape[0]
     data_ax = (DATA_AXIS if DATA_AXIS in mesh.shape
@@ -135,10 +141,7 @@ def _shard_decode_inputs(model, mesh: Mesh, params, buf, rng):
             raise ValueError(
                 f"TP decode shards attention heads: num_heads={heads} "
                 f"must divide by mesh 'model' size {mesh.shape[MODEL_AXIS]}")
-        specs = lm_param_specs(params)
-        params = jax.device_put(params, jax.tree.map(
-            lambda s: NamedSharding(mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P)))
+        params = shard_lm_params(mesh, params)  # THE training TP placement
     else:
         params = jax.device_put(params, NamedSharding(mesh, P()))
     buf = jax.device_put(buf, NamedSharding(mesh, P(data_ax)))

@@ -159,7 +159,7 @@ class LMTrainer:
             self.tx = FusedAdamW(self.lr_schedule, b1=cfg.adam_b1,
                                  b2=cfg.adam_b2, eps=cfg.adam_eps,
                                  weight_decay=cfg.weight_decay,
-                                 interpret=jax.default_backend() == "cpu")
+                                 interpret=jax.default_backend() != "tpu")
         else:
             self.tx = make_optimizer(cfg.lr, cfg.momentum, cfg.weight_decay,
                                      schedule=self.lr_schedule,
