@@ -103,12 +103,20 @@ def main():
         prompt = jnp.asarray([[seed, (seed * 5 + 7) % trainer.vocab_size]],
                              jnp.int32)
         # sp's model closes over mesh axis names (ring attention); decode
-        # with the dense equivalent — same weights, same math. Dense models
-        # decode through the KV cache; MoE uses full recompute.
-        gen_model = (tiny_lm(**trainer._model_ctor_kw) if trainer.use_sp
-                     else trainer.model)
+        # with the full-attention equivalent — same weights, same math.
+        # The class must match the weights: tiny_lm's **_ catch-all would
+        # silently swallow MoE kwargs and build a dense model that cannot
+        # apply MoE params. Dense AND MoE models decode through the KV
+        # cache (round-5: models.transformer.attend_maybe_cached is shared).
+        if trainer.use_sp and cfg.num_experts:
+            from tpu_dist.models.moe import MoETransformerLM
+            gen_model = MoETransformerLM(**trainer._model_ctor_kw)
+        elif trainer.use_sp:
+            gen_model = tiny_lm(**trainer._model_ctor_kw)
+        else:
+            gen_model = trainer.model
         out = np.asarray(generate(gen_model, host_params, prompt, steps=n,
-                                  use_cache=not cfg.num_experts))
+                                  use_cache=True))
         follows = sum(int(out[0, i + 1])
                       == (int(out[0, i]) * 5 + 7) % trainer.vocab_size
                       for i in range(1, n + 1))

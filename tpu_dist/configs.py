@@ -143,6 +143,9 @@ class LMConfig:
                                    # identical to the dp grouping)
     moe_aux_weight: float = 0.01   # weight of the router balance+z losses
                                    # in the objective (every MoE mode)
+    moe_capacity_factor: float = 1.25  # per-expert queue = S/E * factor * k
+                                   # (>= E/k makes dispatch drop-free —
+                                   # models/moe.py capacity notes)
     attn: str = "full"             # full | blockwise | flash (Pallas FA2)
     attn_block: int = 1024         # KV block for blockwise/flash (clamped
                                    # to seq_len; 1024 measured ~20% faster

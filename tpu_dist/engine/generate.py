@@ -61,10 +61,17 @@ def generate(model, params, prompt: jax.Array, steps: int,
     P+steps must not exceed the model's max_len.
 
     ``use_cache=True`` decodes through the model's per-block KV cache
-    (TransformerLM ``decode=True``): each tick embeds ONE token and attends
-    over the cached keys/values — O(L·d) per token instead of the
-    full-recompute path's O(L²·d). Requires a cache-capable model (the
-    dense TransformerLM; MoE models use the default full-recompute path).
+    (``decode=True``): each tick embeds ONE token and attends over the
+    cached keys/values — O(L·d) per token instead of the full-recompute
+    path's O(L²·d). Both the dense TransformerLM and MoETransformerLM are
+    cache-capable (they share models.transformer.attend_maybe_cached). MoE
+    caveat: per-expert capacity is GROUP-LENGTH-dependent (cap = S/E *
+    capacity_factor * k) and the cached prefill groups only the prompt
+    while the full path groups the whole padded buffer, so the two paths'
+    token drops — and therefore their outputs — only agree exactly when
+    capacity admits every token (capacity_factor >= E/k) AND B=1 (B>1 adds
+    cross-row queue interference). Otherwise both are valid decodes under
+    the same dropped-token semantics training has, just not bitwise equal.
 
     ``mesh`` (VERDICT r4 #3) runs the SAME compiled programs sharded: the
     token buffer batch-shards over 'data' (when it divides B), the weights

@@ -379,7 +379,8 @@ class LMTrainer:
             # rebind, windowed) builds the identical model from ONE dict
             lm_kw = dict(lm_kw, num_experts=cfg.num_experts,
                          router_top_k=cfg.router_top_k,
-                         group_size=cfg.moe_group_size)
+                         group_size=cfg.moe_group_size,
+                         capacity_factor=cfg.moe_capacity_factor)
             model = MoETransformerLM(**lm_kw)
         else:
             from tpu_dist.models.transformer import tiny_lm

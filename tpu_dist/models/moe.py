@@ -148,10 +148,12 @@ class MoEBlock(nn.Module):
     attn_fn: Callable = None  # default set in __call__ to avoid import cycle
     router_top_k: int = 1
     group_size: int = 512
+    capacity_factor: float = 1.25
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
-        from tpu_dist.models.transformer import full_attention
+    def __call__(self, x, train: bool = True, decode: bool = False):
+        from tpu_dist.models.transformer import (attend_maybe_cached,
+                                                 full_attention)
 
         attn = self.attn_fn or full_attention
         d_model = x.shape[-1]
@@ -161,13 +163,17 @@ class MoEBlock(nn.Module):
                        name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shp = (x.shape[0], x.shape[1], self.num_heads, head_dim)
-        out = attn(q.reshape(shp), k.reshape(shp), v.reshape(shp))
+        out = attend_maybe_cached(self, q.reshape(shp), k.reshape(shp),
+                                  v.reshape(shp), decode=decode,
+                                  attn_fn=attn, dtype=self.dtype)
         x = x + nn.Dense(d_model, use_bias=False, dtype=self.dtype,
                          name="proj")(out.reshape(x.shape))
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         x = x + MoEMLP(self.num_experts, dtype=self.dtype,
                        router_top_k=self.router_top_k,
-                       group_size=self.group_size, name="moe")(h, train)
+                       group_size=self.group_size,
+                       capacity_factor=self.capacity_factor,
+                       name="moe")(h, train)
         return x
 
 
@@ -187,6 +193,12 @@ class MoETransformerLM(nn.Module):
                            # sequence parallelism groups are shard-local,
                            # so a group_size dividing the shard's tokens
                            # keeps routing identical to the dp grouping)
+    capacity_factor: float = 1.25  # per-expert queue = S/E * factor * k.
+                           # Capacity is GROUP-LENGTH-dependent, so paths
+                           # that group the same tokens differently (e.g.
+                           # KV-cache prefill vs full-recompute decode)
+                           # only agree exactly when capacity admits every
+                           # token; factor >= E/k makes dispatch drop-free.
     remat: bool = False  # rematerialize each MoE block in the backward pass
                          # (the expert dispatch/combine tensors are the
                          # memory hogs — jax.checkpoint per block is the
@@ -194,18 +206,23 @@ class MoETransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, pos_offset=0,
-                 return_features: bool = False):
+                 decode: bool = False, return_features: bool = False):
+        # decode=True enables the per-block KV cache (same pattern as the
+        # dense TransformerLM — engine.generate's use_cache path); the MoE
+        # MLP itself is per-token/stateless, so routing a single decode
+        # position is exact (its group is just the current batch column)
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                      name="tok_emb")(tokens)
         pos = pos_offset + jnp.arange(tokens.shape[1])
         x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
                          name="pos_emb")(pos)[None]
-        block_cls = (nn.remat(MoEBlock, static_argnums=(2,)) if self.remat
+        block_cls = (nn.remat(MoEBlock, static_argnums=(2, 3)) if self.remat
                      else MoEBlock)
         for i in range(self.num_layers):
             x = block_cls(self.num_heads, self.num_experts, self.dtype,
                           self.attn_fn, self.router_top_k, self.group_size,
-                          name=f"block{i}")(x, train)
+                          self.capacity_factor,
+                          name=f"block{i}")(x, train, decode)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_features:
             # chunked-loss path (ops.fused_xent): head applied per row-chunk

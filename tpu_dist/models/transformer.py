@@ -46,6 +46,37 @@ def full_attention(q, k, v, *, causal: bool = True,
     return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
 
 
+def attend_maybe_cached(mdl: nn.Module, q, k, v, *, decode: bool,
+                        attn_fn: Callable, dtype) -> jax.Array:
+    """Attention contraction, maintaining ``mdl``'s per-block KV cache when
+    ``decode`` (the standard flax decode pattern): the cache is allocated
+    at init time from the full-length input, then one position is written
+    per step, and attention runs over the whole buffer with the causal mask
+    hiding positions > cache_index (they are zeros anyway). Shared by the
+    dense Block and MoEBlock so both families decode through ONE cache
+    implementation. Decode always uses exact full attention over the cache:
+    the attn_fn plug-in (flash/blockwise/ring) exists for TRAINING-time
+    memory, and flash's custom_vjp can't take the traced cache index as its
+    static offset anyway."""
+    if not decode:
+        return attn_fn(q, k, v)
+    is_init = mdl.has_variable("cache", "cached_k")
+    ck = mdl.variable("cache", "cached_k", jnp.zeros, k.shape, dtype)
+    cv = mdl.variable("cache", "cached_v", jnp.zeros, v.shape, dtype)
+    ci = mdl.variable("cache", "cache_index",
+                      lambda: jnp.zeros((), jnp.int32))
+    if not is_init:
+        return attn_fn(q, k, v)
+    idx = ci.value
+    z = jnp.zeros((), idx.dtype)  # match idx dtype (x64-safe)
+    ck.value = jax.lax.dynamic_update_slice(
+        ck.value, k.astype(dtype), (z, idx, z, z))
+    cv.value = jax.lax.dynamic_update_slice(
+        cv.value, v.astype(dtype), (z, idx, z, z))
+    ci.value = idx + q.shape[1]
+    return full_attention(q, ck.value, cv.value, q_offset=idx, kv_offset=0)
+
+
 class Block(nn.Module):
     num_heads: int
     dtype: jnp.dtype = jnp.float32
@@ -61,36 +92,8 @@ class Block(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shp = (x.shape[0], x.shape[1], self.num_heads, head_dim)
         q, k, v = q.reshape(shp), k.reshape(shp), v.reshape(shp)
-        if decode:
-            # KV cache (standard flax decode pattern): allocated at init
-            # time from the full-length input, then one position written per
-            # step. Attention runs over the whole buffer with the causal
-            # mask hiding positions > cache_index (they are zeros anyway).
-            is_init = self.has_variable("cache", "cached_k")
-            ck = self.variable("cache", "cached_k", jnp.zeros, k.shape,
-                               self.dtype)
-            cv = self.variable("cache", "cached_v", jnp.zeros, v.shape,
-                               self.dtype)
-            ci = self.variable("cache", "cache_index",
-                               lambda: jnp.zeros((), jnp.int32))
-            if is_init:
-                idx = ci.value
-                z = jnp.zeros((), idx.dtype)  # match idx dtype (x64-safe)
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, k.astype(self.dtype), (z, idx, z, z))
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, v.astype(self.dtype), (z, idx, z, z))
-                ci.value = idx + q.shape[1]
-                # decode always uses exact full attention over the cache:
-                # the attn_fn plug-in (flash/blockwise/ring) exists for
-                # TRAINING-time memory, and flash's custom_vjp can't take
-                # the traced cache index as its static offset anyway
-                out = full_attention(q, ck.value, cv.value,
-                                     q_offset=idx, kv_offset=0)
-            else:
-                out = self.attn_fn(q, k, v)
-        else:
-            out = self.attn_fn(q, k, v)
+        out = attend_maybe_cached(self, q, k, v, decode=decode,
+                                  attn_fn=self.attn_fn, dtype=self.dtype)
         out = out.reshape(x.shape)
         x = x + nn.Dense(d_model, use_bias=False, dtype=self.dtype,
                          name="proj")(out)
