@@ -94,7 +94,6 @@ def main():
         from tpu_dist.engine.generate import generate
         host_params = gather_to_host(trainer.state.params)
     if args.generate and jax.process_index() == 0:
-        from tpu_dist.models.transformer import tiny_lm
         if trainer.use_pp:
             from tpu_dist.parallel.pp import unstack_pipeline_params
             host_params = unstack_pipeline_params(host_params)
@@ -104,17 +103,13 @@ def main():
                              jnp.int32)
         # sp's model closes over mesh axis names (ring attention); decode
         # with the full-attention equivalent — same weights, same math.
-        # The class must match the weights: tiny_lm's **_ catch-all would
-        # silently swallow MoE kwargs and build a dense model that cannot
-        # apply MoE params. Dense AND MoE models decode through the KV
-        # cache (round-5: models.transformer.attend_maybe_cached is shared).
-        if trainer.use_sp and cfg.num_experts:
-            from tpu_dist.models.moe import MoETransformerLM
-            gen_model = MoETransformerLM(**trainer._model_ctor_kw)
-        elif trainer.use_sp:
-            gen_model = tiny_lm(**trainer._model_ctor_kw)
-        else:
-            gen_model = trainer.model
+        # trainer._sp_ctor already encodes the dense-vs-MoE class choice
+        # with the right ctor kwargs (one definition, lm_loop._build_steps);
+        # tiny_lm's **_ catch-all would otherwise silently swallow MoE
+        # kwargs and build a model that cannot apply the trained params.
+        # Dense AND MoE models decode through the KV cache (round-5:
+        # models.transformer.attend_maybe_cached is shared).
+        gen_model = trainer._sp_ctor() if trainer.use_sp else trainer.model
         out = np.asarray(generate(gen_model, host_params, prompt, steps=n,
                                   use_cache=True))
         follows = sum(int(out[0, i + 1])

@@ -251,3 +251,17 @@ def test_moe_top2_cached_decode_matches_full():
     full = generate(moe, params, prompt, steps=8)
     cached = generate(moe, params, prompt, steps=8, use_cache=True)
     np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+
+def test_moe_ep_sharded_decode_matches_single_device():
+    """EP decode: expert weights sharded over 'expert' (GShard dispatch
+    all-to-alls via GSPMD) emit the same greedy tokens as single-device,
+    full-recompute AND cached paths (drop-free capacity)."""
+    moe, params = _moe_and_params(seed=25, capacity_factor=2.0)
+    mesh = make_mesh((2, 2), ("data", "expert"), devices=jax.devices()[:4])
+    prompt = jnp.asarray([[4, 8, 15, 16], [23, 42, 7, 1]], jnp.int32)
+    single = generate(moe, params, prompt, steps=8, use_cache=True)
+    for use_cache in (False, True):
+        ep = generate(moe, params, prompt, steps=8, mesh=mesh,
+                      use_cache=use_cache)
+        np.testing.assert_array_equal(np.asarray(single), np.asarray(ep))

@@ -44,6 +44,10 @@ def main():
                          "cuts ms/token ~linearly when devices exist.")
     ap.add_argument("--dp", type=int, default=0,
                     help="decode over a ('data',) mesh: batch-sharded")
+    ap.add_argument("--num-experts", type=int, default=0,
+                    help="bench the MoE LM (cached decode via the shared "
+                         "attend_maybe_cached) instead of the dense one")
+    ap.add_argument("--capacity-factor", type=float, default=1.25)
     ap.add_argument("--skip-full", action="store_true",
                     help="skip the O(L^2) full-recompute reference "
                          "(slow at long totals)")
@@ -72,10 +76,18 @@ def main():
 
     total = args.prompt_len + args.steps
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
-    model = TransformerLM(
-        vocab_size=args.vocab_size, num_layers=args.num_layers,
-        d_model=args.d_model, num_heads=args.num_heads, max_len=total,
-        dtype=dtype)
+    if args.num_experts:
+        from tpu_dist.models.moe import MoETransformerLM
+        model = MoETransformerLM(
+            vocab_size=args.vocab_size, num_layers=args.num_layers,
+            d_model=args.d_model, num_heads=args.num_heads, max_len=total,
+            num_experts=args.num_experts,
+            capacity_factor=args.capacity_factor, dtype=dtype)
+    else:
+        model = TransformerLM(
+            vocab_size=args.vocab_size, num_layers=args.num_layers,
+            d_model=args.d_model, num_heads=args.num_heads, max_len=total,
+            dtype=dtype)
     params = model.init({"params": jax.random.PRNGKey(0)},
                         np.zeros((1, 16), np.int32), train=False)["params"]
     rng = np.random.default_rng(0)
@@ -155,6 +167,7 @@ def main():
         "precision": args.precision,
         "temperature": args.temperature, "top_k": args.top_k,
         "top_p": args.top_p, "tp": args.tp, "dp": args.dp,
+        "num_experts": args.num_experts,
     }))
 
 

@@ -67,11 +67,12 @@ def generate(model, params, prompt: jax.Array, steps: int,
     cache-capable (they share models.transformer.attend_maybe_cached). MoE
     caveat: per-expert capacity is GROUP-LENGTH-dependent (cap = S/E *
     capacity_factor * k) and the cached prefill groups only the prompt
-    while the full path groups the whole padded buffer, so the two paths'
-    token drops — and therefore their outputs — only agree exactly when
-    capacity admits every token (capacity_factor >= E/k) AND B=1 (B>1 adds
-    cross-row queue interference). Otherwise both are valid decodes under
-    the same dropped-token semantics training has, just not bitwise equal.
+    while the full path groups the whole padded buffer, so the two paths
+    can drop DIFFERENT tokens. Drop-free capacity (capacity_factor >= E/k,
+    --moe-capacity-factor) makes them bitwise equal at any batch size —
+    every token is admitted, so grouping can't matter. Under capacity
+    pressure both remain valid decodes with training's dropped-token
+    semantics, just not bitwise equal to each other.
 
     ``mesh`` (VERDICT r4 #3) runs the SAME compiled programs sharded: the
     token buffer batch-shards over 'data' (when it divides B), the weights
@@ -133,6 +134,7 @@ def _shard_decode_inputs(model, mesh: Mesh, params, buf, rng):
     the mesh doesn't carry (or that don't divide) fall back to replication,
     so a ('data',)-only mesh and a ('model',)-only mesh both just work.
     """
+    from tpu_dist.parallel.ep import EXPERT_AXIS, shard_moe_params
     from tpu_dist.parallel.mesh import DATA_AXIS, MODEL_AXIS
     from tpu_dist.parallel.tp import shard_lm_params
 
@@ -142,12 +144,24 @@ def _shard_decode_inputs(model, mesh: Mesh, params, buf, rng):
                else None)
     model_ax = (MODEL_AXIS if MODEL_AXIS in mesh.shape
                 and mesh.shape[MODEL_AXIS] > 1 else None)
+    experts = getattr(model, "num_experts", 0)
+    expert_ax = (EXPERT_AXIS if experts and EXPERT_AXIS in mesh.shape
+                 and mesh.shape[EXPERT_AXIS] > 1 else None)
     if model_ax:
         heads = getattr(model, "num_heads", 0)
         if heads % mesh.shape[MODEL_AXIS]:
             raise ValueError(
                 f"TP decode shards attention heads: num_heads={heads} "
                 f"must divide by mesh 'model' size {mesh.shape[MODEL_AXIS]}")
+    if expert_ax:
+        if experts % mesh.shape[EXPERT_AXIS]:
+            raise ValueError(
+                f"EP decode shards experts: num_experts={experts} must "
+                f"divide by mesh 'expert' size {mesh.shape[EXPERT_AXIS]}")
+        # training EP placement (+ Megatron split when 'model' rides along);
+        # GSPMD turns the dispatch/combine einsums into decode all-to-alls
+        params = shard_moe_params(mesh, params, model_axis=model_ax)
+    elif model_ax:
         params = shard_lm_params(mesh, params)  # THE training TP placement
     else:
         params = jax.device_put(params, NamedSharding(mesh, P()))

@@ -705,7 +705,8 @@ class LMTrainer:
                     self.state.params, cfg.num_layers, cfg.seq_len,
                     cfg.d_model, cfg.num_experts, cfg.router_top_k,
                     total_tokens=cfg.batch_size * cfg.seq_len,
-                    group_size=cfg.moe_group_size)
+                    group_size=cfg.moe_group_size,
+                    capacity_factor=cfg.moe_capacity_factor)
             else:
                 per_token = lm_flops_per_token(
                     self.state.params, cfg.num_layers, cfg.seq_len,

@@ -241,7 +241,8 @@ def _stage_apply_aux_builder(model):
                      num_experts=model.num_experts, dtype=model.dtype,
                      attn_fn=model.attn_fn,
                      router_top_k=model.router_top_k,
-                     group_size=model.group_size)
+                     group_size=model.group_size,
+                     capacity_factor=model.capacity_factor)
     ln_f = nn.LayerNorm(dtype=jnp.float32)
 
     def apply_stage(blocks_local, x):
