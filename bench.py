@@ -57,19 +57,12 @@ ARCH = os.environ.get("BENCH_ARCH", "resnet50")
 NUM_CLASSES = int(os.environ.get("BENCH_NUM_CLASSES", "10"))
 
 
-def lm_bench():
-    """BENCH_ARCH=transformer_lm: tokens/sec/chip + MFU for the LM engine.
-
-    Drives the SAME windowed HBM-resident path LMTrainer trains with
-    (make_lm_indexed_multi_train_step): K optimizer steps per dispatch over
-    device-resident rows, bf16 compute, flash attention. Knobs:
-    BENCH_SEQ_LEN (2048), BENCH_D_MODEL (1024), BENCH_LAYERS (8),
-    BENCH_HEADS (8), BENCH_VOCAB (32000), BENCH_LM_BATCH per chip (8),
-    BENCH_ATTN full|blockwise|flash (flash), BENCH_REMAT=1.
-    Completion is forced with a device_get readback (block_until_ready does
-    not reliably block across tunneled controllers); the ~0.1s readback is
-    amortized over the multi-second window.
-    """
+def lm_build():
+    """THE windowed-LM-step builder shared by lm_bench and
+    tools/profile_lm.py (the profiler must capture the SAME program the
+    bench times — a hand-copied setup drifts; ADVICE/code-review r5).
+    Reads the BENCH_* env knobs and returns a dict with the compiled-input
+    pieces plus the geometry the callers report."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -80,15 +73,6 @@ def lm_bench():
     from tpu_dist.models.transformer import TransformerLM, full_attention
     from tpu_dist.ops import make_optimizer
     from tpu_dist.parallel.mesh import make_mesh, replicated
-    from tpu_dist.utils.mfu import (lm_flops_per_token, peak_tflops_for,
-                                    step_flops)
-
-    if ARCH != "transformer_lm":
-        raise SystemExit(
-            f"BENCH_ARCH={ARCH}: the LM bench drives the dense "
-            "TransformerLM only (its analytical MFU accounting assumes "
-            "dense); use BENCH_ARCH=transformer_lm with BENCH_* geometry "
-            "knobs")
 
     n_chips = jax.device_count()
     L = int(os.environ.get("BENCH_SEQ_LEN", "2048"))
@@ -100,7 +84,6 @@ def lm_bench():
     attn_kind = os.environ.get("BENCH_ATTN", "flash")
     k = int(os.environ.get("BENCH_STEPS_PER_WINDOW",
                            os.environ.get("BENCH_STEPS", "20")))
-    trials = int(os.environ.get("BENCH_TRIALS", "3"))
     loss_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0"))
 
     if attn_kind == "flash":
@@ -141,10 +124,49 @@ def lm_bench():
     idx = np.tile(np.arange(batch, dtype=np.int32), (k, 1))
     idx_dev = jax.device_put(idx, NamedSharding(mesh, P(None, "data")))
     key = jax.random.PRNGKey(1)
+    return dict(window=window, state=state, rows_dev=rows_dev,
+                idx_dev=idx_dev, key=key, params=params, mesh=mesh,
+                n_chips=n_chips, L=L, d_model=d_model, layers=layers,
+                batch=batch, k=k, attn_kind=attn_kind,
+                loss_chunk=loss_chunk)
+
+
+def lm_bench():
+    """BENCH_ARCH=transformer_lm: tokens/sec/chip + MFU for the LM engine.
+
+    Drives the SAME windowed HBM-resident path LMTrainer trains with
+    (make_lm_indexed_multi_train_step): K optimizer steps per dispatch over
+    device-resident rows, bf16 compute, flash attention. Knobs:
+    BENCH_SEQ_LEN (2048), BENCH_D_MODEL (1024), BENCH_LAYERS (8),
+    BENCH_HEADS (8), BENCH_VOCAB (32000), BENCH_LM_BATCH per chip (8),
+    BENCH_ATTN full|blockwise|flash (flash), BENCH_REMAT=1,
+    BENCH_OPTIMIZER sgd|adamw|fused_adamw, BENCH_LOSS_CHUNK.
+    Completion is forced with a device_get readback (block_until_ready does
+    not reliably block across tunneled controllers); the ~0.1s readback is
+    amortized over the multi-second window.
+    """
+    import jax
+    from tpu_dist.utils.mfu import (lm_flops_per_token, peak_tflops_for,
+                                    step_flops)
+
+    if ARCH != "transformer_lm":
+        raise SystemExit(
+            f"BENCH_ARCH={ARCH}: the LM bench drives the dense "
+            "TransformerLM only (its analytical MFU accounting assumes "
+            "dense); use BENCH_ARCH=transformer_lm with BENCH_* geometry "
+            "knobs")
+
+    b = lm_build()
+    window, state = b["window"], b["state"]
+    rows_dev, idx_dev, key = b["rows_dev"], b["idx_dev"], b["key"]
+    n_chips, L, batch, k = b["n_chips"], b["L"], b["batch"], b["k"]
+    layers, d_model = b["layers"], b["d_model"]
+    attn_kind, loss_chunk = b["attn_kind"], b["loss_chunk"]
+    trials = int(os.environ.get("BENCH_TRIALS", "3"))
 
     # analytical model FLOPs (tpu_dist.utils.mfu.lm_flops_per_token; XLA's
     # cost model undercounts scan bodies and cannot cost Pallas kernels)
-    flops_per_token = lm_flops_per_token(params, layers, L, d_model)
+    flops_per_token = lm_flops_per_token(b["params"], layers, L, d_model)
     xla_flops = step_flops(window, state, rows_dev, idx_dev, key)
     if xla_flops:
         print(f"xla cost model (diagnostic only): "
