@@ -57,12 +57,33 @@ ARCH = os.environ.get("BENCH_ARCH", "resnet50")
 NUM_CLASSES = int(os.environ.get("BENCH_NUM_CLASSES", "10"))
 
 
+def lm_geometry():
+    """(env-derived) LM bench geometry — THE single parse of the BENCH_*
+    geometry knobs, shared by lm_build and profile_lm's parse-only path so
+    trace renormalization can never drift from the capture."""
+    import jax
+
+    n_chips = jax.device_count()
+    return dict(
+        n_chips=n_chips,
+        L=int(os.environ.get("BENCH_SEQ_LEN", "2048")),
+        d_model=int(os.environ.get("BENCH_D_MODEL", "1024")),
+        layers=int(os.environ.get("BENCH_LAYERS", "8")),
+        heads=int(os.environ.get("BENCH_HEADS", "8")),
+        vocab=int(os.environ.get("BENCH_VOCAB", "32000")),
+        batch=int(os.environ.get("BENCH_LM_BATCH", "8")) * n_chips,
+        attn_kind=os.environ.get("BENCH_ATTN", "flash"),
+        k=int(os.environ.get("BENCH_STEPS_PER_WINDOW",
+                             os.environ.get("BENCH_STEPS", "20"))),
+        loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "0")))
+
+
 def lm_build():
     """THE windowed-LM-step builder shared by lm_bench and
     tools/profile_lm.py (the profiler must capture the SAME program the
     bench times — a hand-copied setup drifts; ADVICE/code-review r5).
-    Reads the BENCH_* env knobs and returns a dict with the compiled-input
-    pieces plus the geometry the callers report."""
+    Reads the BENCH_* env knobs (lm_geometry) and returns a dict with the
+    compiled-input pieces plus the geometry the callers report."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -74,17 +95,11 @@ def lm_build():
     from tpu_dist.ops import make_optimizer
     from tpu_dist.parallel.mesh import make_mesh, replicated
 
-    n_chips = jax.device_count()
-    L = int(os.environ.get("BENCH_SEQ_LEN", "2048"))
-    d_model = int(os.environ.get("BENCH_D_MODEL", "1024"))
-    layers = int(os.environ.get("BENCH_LAYERS", "8"))
-    heads = int(os.environ.get("BENCH_HEADS", "8"))
-    vocab = int(os.environ.get("BENCH_VOCAB", "32000"))
-    batch = int(os.environ.get("BENCH_LM_BATCH", "8")) * n_chips
-    attn_kind = os.environ.get("BENCH_ATTN", "flash")
-    k = int(os.environ.get("BENCH_STEPS_PER_WINDOW",
-                           os.environ.get("BENCH_STEPS", "20")))
-    loss_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0"))
+    g = lm_geometry()
+    n_chips, L, d_model = g["n_chips"], g["L"], g["d_model"]
+    layers, heads, vocab = g["layers"], g["heads"], g["vocab"]
+    batch, attn_kind, k = g["batch"], g["attn_kind"], g["k"]
+    loss_chunk = g["loss_chunk"]
 
     if attn_kind == "flash":
         from tpu_dist.ops.flash_attention import flash_attention_fn

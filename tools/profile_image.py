@@ -88,8 +88,9 @@ def op_table(xplane_path: str):
     return rows
 
 
-def attribute(rows, k: int, batch: int):
-    """Aggregate device self-time by op type; print attribution tables."""
+def attribute(rows, k: int, batch: int, unit: str = "img"):
+    """Aggregate device self-time by op type; print attribution tables.
+    ``unit`` labels the rate line ("img" here, "tok" for profile_lm)."""
     by_type = defaultdict(lambda: [0.0, 0.0, 0])   # time, flops, count
     total = 0.0
     for d in rows:
@@ -102,7 +103,7 @@ def attribute(rows, k: int, batch: int):
     print(f"\n== device self-time by op type "
           f"(device busy total {total/1e3:.2f} ms over {k} steps; "
           f"{total/k/1e3:.3f} ms/step; "
-          f"{batch*k/(total/1e6):,.0f} img/s device-busy bound) ==")
+          f"{batch*k/(total/1e6):,.0f} {unit}/s device-busy bound) ==")
     for typ, (t, fl, n) in sorted(by_type.items(), key=lambda kv: -kv[1][0]):
         print(f"  {typ:<28} {t/1e3:9.2f} ms  {100*t/total:5.1f}%  x{n}")
     print("\n== top 30 ops by self-time ==")

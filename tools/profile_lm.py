@@ -50,20 +50,15 @@ def main():
     if os.environ.get("PROFILE_PARSE_ONLY") != "1":
         tokens, k = capture(out_dir)
     else:
-        # mirror lm_build's geometry exactly (incl. the device-count factor
-        # and the BENCH_STEPS_PER_WINDOW precedence) so a parse-only rerun
-        # normalizes the same trace to the same numbers
-        import jax
-        L = int(os.environ.get("BENCH_SEQ_LEN", "2048"))
-        tokens = (int(os.environ.get("BENCH_LM_BATCH", "8"))
-                  * jax.device_count() * L)
-        k = int(os.environ.get("BENCH_STEPS_PER_WINDOW",
-                               os.environ.get("BENCH_STEPS", "20")))
+        # the SAME geometry parse the capture used (bench.lm_geometry) so a
+        # parse-only rerun normalizes the trace to identical numbers
+        import bench
+        g = bench.lm_geometry()
+        tokens, k = g["batch"] * g["L"], g["k"]
     xp = find_xplane(out_dir)
     print(f"xplane: {xp}", file=sys.stderr)
     rows = op_table(xp)
-    # attribute() labels its rate line "img/s"; here items are TOKENS/step
-    attribute(rows, k, tokens)
+    attribute(rows, k, tokens, unit="tok")
 
 
 if __name__ == "__main__":
