@@ -48,7 +48,9 @@ def test_hbm_sampler_writes_schema_and_rows(tmp_path):
     assert rows[0] == CSV_HEADER.split(",")
     assert len(rows) >= 3          # several 50ms samples in 300ms
     assert float(rows[1][0]) > 0   # ts column
-    assert rows[1][4] != ""        # host RSS present on linux
+    import sys as _sys
+    if _sys.platform == "linux":   # /proc-backed; empty elsewhere by design
+        assert rows[1][4] != ""    # host RSS
     # stop() is idempotent-safe to the file: no rows after close
     n = len(rows)
     time.sleep(0.1)

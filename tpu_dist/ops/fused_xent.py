@@ -1,11 +1,15 @@
 """Chunked vocab cross-entropy: the LM-head memory/bandwidth lever.
 
-The straightforward LM loss (engine.lm_steps.lm_loss_and_metrics) first
-materializes the full (B, L, V) fp32 logits, then log_softmax's them — at the
-bench geometry (B8, L2048, V32k) that is ~2 GB of HBM written by the head
-matmul, read+written again by the softmax, and stashed for the backward pass.
-The reference never hits this (it trains CNNs with a 10-to-1000-way head:
-/root/reference/1.dataparallel.py); a 32k-vocab LM pays it every step.
+The straightforward LM loss (engine.lm_steps.lm_loss_and_metrics)
+materializes the full (B, L, V) fp32 logits — at the bench geometry (B8,
+L2048, V32k) ~2 GB of HBM written by the head matmul, reduced by a
+logsumexp (since round 5 it no longer writes a second log_softmax tensor),
+and rematerialized as softmax-minus-onehot in the backward. The reference
+never hits this (it trains CNNs with a 10-to-1000-way head:
+/root/reference/1.dataparallel.py); a large-vocab LM pays it every step —
+and at 100k+ vocabs the (B, L, V) tensor stops fitting at all, which is
+when this chunked path wins (at V=32k it measures net-negative vs the
+unfused loss: BASELINE.md round-5 0.9B table).
 
 :func:`chunked_softmax_xent` computes the identical loss without ever holding
 more than one (chunk, V) logits tile:
