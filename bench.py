@@ -75,7 +75,8 @@ def lm_geometry():
         attn_kind=os.environ.get("BENCH_ATTN", "flash"),
         k=int(os.environ.get("BENCH_STEPS_PER_WINDOW",
                              os.environ.get("BENCH_STEPS", "20"))),
-        loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "0")))
+        loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "0")),
+        quant=os.environ.get("BENCH_QUANT") or "none")
 
 
 def lm_build():
@@ -100,6 +101,8 @@ def lm_build():
     layers, heads, vocab = g["layers"], g["heads"], g["vocab"]
     batch, attn_kind, k = g["batch"], g["attn_kind"], g["k"]
     loss_chunk = g["loss_chunk"]
+    from tpu_dist.ops.quant import validate_quant
+    quant = validate_quant(g["quant"])
 
     if attn_kind == "flash":
         from tpu_dist.ops.flash_attention import flash_attention_fn
@@ -113,7 +116,7 @@ def lm_build():
     model = TransformerLM(
         vocab_size=vocab, num_layers=layers, d_model=d_model,
         num_heads=heads, max_len=L, dtype=jnp.bfloat16, attn_fn=attn_fn,
-        remat=os.environ.get("BENCH_REMAT") == "1")
+        remat=os.environ.get("BENCH_REMAT") == "1", quant=quant)
     params = model.init({"params": jax.random.PRNGKey(0)},
                         np.zeros((1, L), np.int32), train=False)["params"]
     opt = os.environ.get("BENCH_OPTIMIZER", "sgd")
@@ -143,7 +146,7 @@ def lm_build():
                 idx_dev=idx_dev, key=key, params=params, mesh=mesh,
                 n_chips=n_chips, L=L, d_model=d_model, layers=layers,
                 batch=batch, k=k, attn_kind=attn_kind,
-                loss_chunk=loss_chunk)
+                loss_chunk=loss_chunk, quant=quant)
 
 
 def lm_bench():
@@ -176,7 +179,7 @@ def lm_bench():
     rows_dev, idx_dev, key = b["rows_dev"], b["idx_dev"], b["key"]
     n_chips, L, batch, k = b["n_chips"], b["L"], b["batch"], b["k"]
     layers, d_model = b["layers"], b["d_model"]
-    attn_kind, loss_chunk = b["attn_kind"], b["loss_chunk"]
+    attn_kind, loss_chunk, quant = b["attn_kind"], b["loss_chunk"], b["quant"]
     trials = int(os.environ.get("BENCH_TRIALS", "3"))
 
     # analytical model FLOPs (tpu_dist.utils.mfu.lm_flops_per_token; XLA's
@@ -203,13 +206,21 @@ def lm_bench():
     print(f"lm {layers}L/d{d_model} L={L} b/chip={batch // n_chips} "
           f"attn={attn_kind}"
           + (f" loss_chunk={loss_chunk}" if loss_chunk else "")
+          + (f" quant={quant}" if quant != "none" else "")
           + f": {tok_chip:,.0f} tok/s/chip, trials "
           f"{[round(r / n_chips) for r in rates]}"
           + (f", {tflops:.1f} TFLOP/s/chip" if tflops else "")
-          + (f", MFU {mfu * 100:.1f}% of {peak} TF peak" if mfu else ""),
+          + (f", MFU {mfu * 100:.1f}% of {peak} TF peak (bf16 peak; the "
+             "int8 MXU path doubles it)" if mfu and quant == "int8" else
+             f", MFU {mfu * 100:.1f}% of {peak} TF peak" if mfu else ""),
           file=sys.stderr)
+    # BENCH_QUANT publishes its OWN metric name: the quantized variant rides
+    # alongside the bf16 headline, never replacing it (the headline's name —
+    # and its baseline comparison — must stay like-for-like bf16)
+    quant_tag = f"_{quant}" if quant != "none" else ""
     print(json.dumps({
-        "metric": f"lm_{layers}l_d{d_model}_seq{L}_tokens_per_sec_per_chip",
+        "metric": f"lm_{layers}l_d{d_model}_seq{L}{quant_tag}"
+                  "_tokens_per_sec_per_chip",
         "value": round(tok_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": 1.0,
@@ -302,6 +313,14 @@ def main():
     if model_kind(ARCH) == "lm":
         lm_bench()
         return
+
+    if os.environ.get("BENCH_QUANT", "none") not in ("", "none"):
+        # refuse rather than silently publish a bf16 number under the
+        # user's int8 intent: the conv models have no quantized path
+        raise SystemExit(
+            f"BENCH_QUANT={os.environ['BENCH_QUANT']} applies to the LM "
+            f"bench only (BENCH_ARCH=transformer_lm); BENCH_ARCH={ARCH} "
+            "has no quantized path")
 
     n_chips = jax.device_count()
     per_chip_batch = int(os.environ.get("BENCH_PER_CHIP_BATCH", "1024"))
@@ -421,11 +440,21 @@ def main():
         pass
     vs = ips_per_chip / baseline if baseline else 1.0
 
+    # like-for-like tagging: BASELINE.json's published number is the ROUND-1
+    # config (7x7 imagenet stem, fp32 norm outputs); today's default is
+    # s2d+bf16-norm. The ratio is still published (it tracks the headline's
+    # drift across rounds), but both configs ride the JSON so the comparison
+    # is never silently cross-config.
+    active_cfg = (f"stem={kwargs.get('stem', 'imagenet')}"
+                  f",norm_dtype={norm_dtype}")
+    baseline_cfg = "stem=imagenet,norm_dtype=fp32"
     print(json.dumps({
         "metric": "cifar10_resnet50_images_per_sec_per_chip",
         "value": round(ips_per_chip, 1),
         "unit": "images/sec/chip",
+        "config": active_cfg,
         "vs_baseline": round(vs, 3),
+        "vs_baseline_config": baseline_cfg if baseline else None,
         "mfu": round(mfu, 4) if mfu else None,
         "tflops": round(tflops, 2) if tflops else None,
         "flops_per_img": round(fpi) if fpi else None,

@@ -16,5 +16,7 @@ if os.environ.get("TPU_DIST_TEST_TPU") != "1":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from tpu_dist._compat import set_cpu_device_count
+
+    set_cpu_device_count(8)
 os.environ.setdefault("JAX_ENABLE_X64", "0")

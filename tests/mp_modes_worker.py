@@ -28,7 +28,8 @@ def main() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", local_devices)
+    from tpu_dist._compat import set_cpu_device_count
+    set_cpu_device_count(local_devices)
 
     from tpu_dist.parallel import launch
 

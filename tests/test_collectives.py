@@ -11,7 +11,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from tpu_dist.parallel import (allreduce_bench, barrier, compress_grads,
                                make_mesh, reduce_mean)
 
-from jax import shard_map
+from tpu_dist._compat import shard_map
 
 
 def test_mesh_shapes():
@@ -66,7 +66,7 @@ def test_adasum_reduce_formula_and_properties():
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from tpu_dist._compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from tpu_dist.parallel.collectives import adasum_reduce
@@ -114,7 +114,7 @@ def test_adasum_per_leaf_vs_whole_tree_differ():
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from tpu_dist._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from tpu_dist.parallel.collectives import adasum_reduce

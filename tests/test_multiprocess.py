@@ -24,6 +24,14 @@ import sys
 import numpy as np
 import pytest
 
+from tpu_dist._compat import CPU_MULTIPROCESS
+
+pytestmark = pytest.mark.skipif(
+    not CPU_MULTIPROCESS,
+    reason="this jax's CPU backend has no multi-process computations "
+           "(_compat.CPU_MULTIPROCESS); the spawned workers would all "
+           "die with INVALID_ARGUMENT at the first collective")
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(ROOT, "tests", "mp_worker.py")
 

@@ -250,6 +250,11 @@ def test_pp_tp_composition_matches_dp(schedule):
     pipeline schedule stays manual (shard_map) while 'model' runs as a
     GSPMD auto axis, so each stage's block math is Megatron-sharded —
     weights verifiably split over BOTH stage and model axes."""
+    from tpu_dist._compat import PARTIAL_MANUAL_SHARD_MAP
+    if not PARTIAL_MANUAL_SHARD_MAP:
+        pytest.skip("pp x tp needs partial-manual shard_map (jax >= 0.6); "
+                    "this jax's experimental shard_map aborts in the SPMD "
+                    "partitioner (_compat.PARTIAL_MANUAL_SHARD_MAP)")
     lm, params, tx, inputs, targets = _setup()
     key = jax.random.PRNGKey(1)
 

@@ -50,6 +50,10 @@ def test_script_2_distributed(tmp_path):
 
 
 def test_script_3_spawn_two_processes(tmp_path):
+    from tpu_dist._compat import CPU_MULTIPROCESS
+    if not CPU_MULTIPROCESS:
+        pytest.skip("this jax's CPU backend has no multi-process "
+                    "computations (_compat.CPU_MULTIPROCESS)")
     out = run_script(tmp_path, "3.multiprocessing_spawn.py",
                      TINY + ck(tmp_path),
                      env_extra={"TPU_DIST_NPROCS_SPAWN": "2"})

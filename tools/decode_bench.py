@@ -66,7 +66,8 @@ def main():
         m = _re.search(r"host_platform_device_count=(\d+)",
                        os.environ.get("XLA_FLAGS", ""))
         if m:
-            jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+            from tpu_dist._compat import set_cpu_device_count
+            set_cpu_device_count(int(m.group(1)))
 
     import jax.numpy as jnp
     import numpy as np

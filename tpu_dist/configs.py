@@ -69,6 +69,11 @@ class TrainConfig:
 
     # -- precision (reference variant 4 apex AMP -> XLA bf16; SURVEY.md §2b apex row)
     precision: str = "fp32"            # fp32 | bf16 | bf16_params
+    quant: str = "none"                # none | int8 | int8_wo (ops.quant):
+                                       # int8 quantized matmuls in the
+                                       # transformer-family archs (vit_*) —
+                                       # the rung above bf16 on the ladder;
+                                       # composes with precision=bf16
     loss_scale: Optional[float] = None # only meaningful if emulating fp16 semantics
     grad_compression: str = "none"     # none | bf16  (hvd.Compression.fp16-equiv,
                                        # reference 5.horovod_distributed.py:123-125)
@@ -156,6 +161,13 @@ class LMConfig:
                                    # N rows of logits at a time, backward
                                    # recomputes (jit, sp, and gpipe-pp)
     precision: str = "fp32"        # fp32 | bf16
+    quant: str = "none"            # none | int8 | int8_wo (ops.quant):
+                                   # int8 dense/attention/expert matmuls
+                                   # with STE training (int8) or weight-only
+                                   # quantization (int8_wo — the
+                                   # memory-bound-decode mode; with
+                                   # loss_chunk > 0 the chunked head stays
+                                   # in the compute dtype)
 
     # -- schedule
     epochs: int = 1

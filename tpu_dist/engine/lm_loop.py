@@ -115,12 +115,26 @@ class LMTrainer:
             {"params": jax.random.PRNGKey(seed)},
             np.zeros((1, cfg.seq_len), np.int32), train=False)["params"]
         if cfg.pretrained:
-            # warm-start BEFORE any pipeline stacking: the donor is a
-            # single-trajectory (non-pp-stacked) checkpoint — the format
-            # every mode here saves after gather (shape-matched graft,
-            # fresh optimizer state; --resume is the continue-a-run path;
-            # existence checked first-line in __init__)
+            # warm-start BEFORE any pipeline stacking, so the donor must be
+            # an UNSTACKED (per-block) param tree. Non-pp runs save exactly
+            # that; a pp run's checkpoint keeps its stage-stacked blocks
+            # (resume needs the stacked layout) and is therefore NOT a
+            # valid --pretrained donor as-is — convert it first with
+            # parallel.pp.unstack_pipeline_params. The stamped pp_stages
+            # meta makes the mismatch detectable, so refuse loudly instead
+            # of letting graft_params silently keep fresh init for every
+            # block. (Shape-matched graft, fresh optimizer state; --resume
+            # is the continue-a-run path; existence checked first-line in
+            # __init__.)
             pre_params, _, pre_meta = ckpt.load_warmstart(cfg.pretrained)
+            if pre_meta.get("pp_stages"):
+                raise ValueError(
+                    f"--pretrained {cfg.pretrained} was saved by a "
+                    f"pipeline-parallel run ({pre_meta['pp_stages']} stages):"
+                    " its blocks are stage-stacked and would not graft onto "
+                    "a fresh model. Unstack it first (parallel.pp."
+                    "unstack_pipeline_params) and re-save, or warm-start "
+                    "from a non-pp checkpoint.")
             params, n_p, skipped = ckpt.graft_params(params, pre_params)
             if n_p == 0:
                 raise ValueError(
@@ -369,10 +383,12 @@ class LMTrainer:
         if self.use_sp and cfg.attn != "full":
             self.log(f"warning: a 'seq' mesh axis uses ring attention; "
                      f"attn={cfg.attn} ignored")
+        from tpu_dist.ops.quant import validate_quant
+        validate_quant(cfg.quant)
         lm_kw = dict(vocab_size=self.vocab_size, num_layers=cfg.num_layers,
                      d_model=cfg.d_model, num_heads=cfg.num_heads,
                      max_len=cfg.seq_len, dtype=self.policy.compute_dtype,
-                     attn_fn=attn_fn, remat=cfg.remat)
+                     attn_fn=attn_fn, remat=cfg.remat, quant=cfg.quant)
         if cfg.num_experts:
             from tpu_dist.models.moe import MoETransformerLM
             # the MoE knobs ride in the ctor kwargs so EVERY mode (jit, sp
@@ -539,11 +555,6 @@ class LMTrainer:
         end = time.time()
         for i, inputs_d, targets_d in stream_prefetch(batches()):
             meters.update("Data", time.time() - end)
-            if getattr(self, "_program_hbm", None) is None:
-                from tpu_dist.utils.telemetry import program_hbm_bytes
-                self._program_hbm = program_hbm_bytes(
-                    self.train_step, self.state, inputs_d, targets_d,
-                    self.rng) or False  # False = probed, unavailable
             self.state, metrics = self.train_step(
                 self.state, inputs_d, targets_d, self.rng)
             if not self._warmed:
@@ -551,6 +562,17 @@ class LMTrainer:
                 self._warmed = True
                 warm_secs = time.time() - end
                 warm_batches = 1
+            if getattr(self, "_program_hbm", None) is None:
+                # probe AFTER the dispatch (and after the warm-timing
+                # device_get, so warm_secs stays honest): the AOT lower/
+                # compile would not seed jit's dispatch cache, so probing
+                # first would compile the step twice (telemetry.py
+                # contract); same-iteration probing keeps the column on
+                # single-dispatch runs
+                from tpu_dist.utils.telemetry import program_hbm_bytes
+                self._program_hbm = program_hbm_bytes(
+                    self.train_step, self.state, inputs_d, targets_d,
+                    self.rng) or False  # False = probed, unavailable
             pending.append(metrics)
             boundary = i % cfg.print_freq == 0 or i == nb - 1
             if boundary:
@@ -610,11 +632,6 @@ class LMTrainer:
         end = time.time()
         for n, idx_dev in windows:
             meters.update("Data", (time.time() - end) / n, n)
-            if getattr(self, "_program_hbm", None) is None:
-                from tpu_dist.utils.telemetry import program_hbm_bytes
-                self._program_hbm = program_hbm_bytes(
-                    self.window_step, self.state, self._train_rows_dev,
-                    idx_dev, self.rng) or False  # False = probed, unavailable
             self.state, metrics = self.window_step(
                 self.state, self._train_rows_dev, idx_dev, self.rng)
             if not self._warmed:
@@ -622,6 +639,13 @@ class LMTrainer:
                 self._warmed = True
                 warm_secs = time.time() - end
                 warm_batches = n
+            if getattr(self, "_program_hbm", None) is None:
+                # post-dispatch probe (same iteration, so single-window
+                # runs record it too): see telemetry.program_hbm_bytes
+                from tpu_dist.utils.telemetry import program_hbm_bytes
+                self._program_hbm = program_hbm_bytes(
+                    self.window_step, self.state, self._train_rows_dev,
+                    idx_dev, self.rng) or False  # False = probed, unavailable
             done += n
             pending.append(metrics)
             boundary = (done - 1) - last_print >= cfg.print_freq or done == nb

@@ -30,7 +30,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from tpu_dist._compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_dist.engine.state import TrainState

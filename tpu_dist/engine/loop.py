@@ -102,9 +102,21 @@ class Trainer:
             raise ValueError(
                 f"--norm/--norm-dtype/--stem are ResNet-family knobs; "
                 f"arch {cfg.arch!r} does not take them")
+        if cfg.quant and cfg.quant != "none":
+            from tpu_dist.ops.quant import validate_quant
+            validate_quant(cfg.quant)
+            if not cfg.arch.startswith("vit"):
+                # int8 matmuls live in the transformer families (ops.quant);
+                # conv stacks would need a quantized-conv path this repo
+                # does not carry yet — refuse rather than silently ignore
+                raise ValueError(
+                    f"--quant {cfg.quant} applies to the transformer-family "
+                    f"image archs (vit_*); arch {cfg.arch!r} does not take it")
+            model_kw["quant"] = cfg.quant
         self.model = create_model(
             cfg.arch, num_classes=self.num_classes,
             dtype=self.policy.compute_dtype, pretrained=cfg.pretrained,
+            warmstart_handled=True,  # grafted below (registry guard)
             **model_kw)
 
         seed = cfg.seed if cfg.seed is not None else 0
@@ -404,16 +416,20 @@ class Trainer:
                 end = time.time()
                 continue
             meters.update("Data", time.time() - end)
+            self.state, metrics = self.train_step(
+                self.state, images, labels, self.rng)
             if getattr(self, "_program_hbm", None) is None:
                 # static per-program peak (CSV column; lower() is abstract,
-                # so donation is untouched and post-warmup this is a cache
-                # hit — see utils.telemetry.program_hbm_bytes)
+                # so donation is untouched). Probed AFTER the dispatch just
+                # above: the AOT compile would not seed jit's dispatch
+                # cache, so probing first would compile the step twice
+                # (utils.telemetry.program_hbm_bytes contract) — and
+                # probing post-dispatch in the SAME iteration means even a
+                # single-dispatch run still records the column
                 from tpu_dist.utils.telemetry import program_hbm_bytes
                 self._program_hbm = program_hbm_bytes(
                     self.train_step, self.state, images, labels,
                     self.rng) or False  # False = probed, unavailable
-            self.state, metrics = self.train_step(
-                self.state, images, labels, self.rng)
             pending.append(metrics)
             boundary = i % cfg.print_freq == 0 or i == nb - 1
             if boundary:
@@ -524,14 +540,16 @@ class Trainer:
             # printed avg keeps the per-batch path's meaning:
             # avg(Time) = wall / batches in both paths
             meters.update("Data", (time.time() - end) / n, n)
+            self.state, metrics = dispatch(self.state, dev_payload)
             if getattr(self, "_program_hbm", None) is None:
+                # post-dispatch probe (same iteration, so single-window
+                # runs record it too): see telemetry.program_hbm_bytes
                 from tpu_dist.utils.telemetry import program_hbm_bytes
                 args = ((*self._train_data_dev, dev_payload, self.rng)
                         if self.device_data else (*dev_payload, self.rng))
                 self._program_hbm = program_hbm_bytes(
                     self.window_step, self.state,
                     *args) or False  # False = probed, unavailable
-            self.state, metrics = dispatch(self.state, dev_payload)
             done += n
             pending.append(metrics)
             boundary = (done - 1) - last_print >= cfg.print_freq or done == nb

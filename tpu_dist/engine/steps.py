@@ -39,7 +39,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from tpu_dist._compat import shard_map
 
 from tpu_dist.engine.state import TrainState
 from tpu_dist.ops import precision as prec

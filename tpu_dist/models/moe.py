@@ -21,6 +21,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tpu_dist.ops.quant import dequantize, make_dense, moe_expert_matmul
+
 
 def moe_group_geometry(total_tokens: int, seq_len: int, num_experts: int,
                        router_top_k: int, group_size: int = 512,
@@ -60,6 +62,10 @@ class MoEMLP(nn.Module):
                                # loss (both ride the single sown aux_loss,
                                # scaled by the step's aux_weight)
     dtype: jnp.dtype = jnp.float32
+    quant: str = "none"        # none | int8 | int8_wo (ops.quant): the
+                               # expert matmuls only — the fp32 router gate
+                               # and the one-hot dispatch/combine einsums
+                               # are selection, not compute, and stay fp
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -122,17 +128,29 @@ class MoEMLP(nn.Module):
         self.sow("intermediates", "combine_mass",
                  jnp.sum(combine, axis=(-2, -1)))
 
-        w_in = self.param("w_in", nn.initializers.lecun_normal(),
-                          (e, d, f)).astype(self.dtype)
-        w_out = self.param("w_out", nn.initializers.lecun_normal(),
-                           (e, f, d)).astype(self.dtype)
+        w_in = self.param("w_in", nn.initializers.lecun_normal(), (e, d, f))
+        w_out = self.param("w_out", nn.initializers.lecun_normal(), (e, f, d))
+        if self.has_variable("params", "w_in_scale"):
+            # pre-quantized weight-only decode (ops.quant.wo_quantize_params):
+            # experts live int8 in HBM, dequantized on the fly
+            w_in = dequantize(w_in, self.get_variable("params", "w_in_scale"),
+                              self.dtype)
+            w_out = dequantize(w_out,
+                               self.get_variable("params", "w_out_scale"),
+                               self.dtype)
+            expert_quant = "none"
+        else:
+            w_in, w_out = w_in.astype(self.dtype), w_out.astype(self.dtype)
+            expert_quant = self.quant
 
         disp_c = disp.astype(self.dtype)
         expert_in = jnp.einsum("gsec,gsd->gecd", disp_c,
                                tokens.astype(self.dtype))      # (G, E, C, D)
-        h = jnp.einsum("gecd,edf->gecf", expert_in, w_in)
+        h = moe_expert_matmul("gecd,edf->gecf", expert_in, w_in,
+                              quant=expert_quant)
         h = nn.gelu(h)
-        expert_out = jnp.einsum("gecf,efd->gecd", h, w_out)    # (G, E, C, D)
+        expert_out = moe_expert_matmul("gecf,efd->gecd", h, w_out,
+                                       quant=expert_quant)     # (G, E, C, D)
         out = jnp.einsum("gsec,gecd->gsd", combine.astype(self.dtype),
                          expert_out)
         # dropped tokens (over capacity) pass through the residual unchanged
@@ -149,6 +167,7 @@ class MoEBlock(nn.Module):
     router_top_k: int = 1
     group_size: int = 512
     capacity_factor: float = 1.25
+    quant: str = "none"
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False):
@@ -159,20 +178,21 @@ class MoEBlock(nn.Module):
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        qkv = nn.Dense(3 * d_model, use_bias=False, dtype=self.dtype,
-                       name="qkv")(h)
+        qkv = make_dense(3 * d_model, use_bias=False, dtype=self.dtype,
+                         name="qkv", quant=self.quant)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shp = (x.shape[0], x.shape[1], self.num_heads, head_dim)
         out = attend_maybe_cached(self, q.reshape(shp), k.reshape(shp),
                                   v.reshape(shp), decode=decode,
                                   attn_fn=attn, dtype=self.dtype)
-        x = x + nn.Dense(d_model, use_bias=False, dtype=self.dtype,
-                         name="proj")(out.reshape(x.shape))
+        x = x + make_dense(d_model, use_bias=False, dtype=self.dtype,
+                           name="proj", quant=self.quant)(out.reshape(x.shape))
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         x = x + MoEMLP(self.num_experts, dtype=self.dtype,
                        router_top_k=self.router_top_k,
                        group_size=self.group_size,
                        capacity_factor=self.capacity_factor,
+                       quant=self.quant,
                        name="moe")(h, train)
         return x
 
@@ -203,6 +223,9 @@ class MoETransformerLM(nn.Module):
                          # (the expert dispatch/combine tensors are the
                          # memory hogs — jax.checkpoint per block is the
                          # same HBM lever the dense LM has)
+    quant: str = "none"  # none | int8 | int8_wo (ops.quant): attention
+                         # projections + expert matmuls + lm_head; router
+                         # gate and dispatch/combine stay fp
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, pos_offset=0,
@@ -221,12 +244,12 @@ class MoETransformerLM(nn.Module):
         for i in range(self.num_layers):
             x = block_cls(self.num_heads, self.num_experts, self.dtype,
                           self.attn_fn, self.router_top_k, self.group_size,
-                          self.capacity_factor,
+                          self.capacity_factor, self.quant,
                           name=f"block{i}")(x, train, decode)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_features:
             # chunked-loss path (ops.fused_xent): head applied per row-chunk
             return x
-        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
-                          name="lm_head")(x)
+        logits = make_dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                            name="lm_head", quant=self.quant)(x)
         return logits.astype(jnp.float32)

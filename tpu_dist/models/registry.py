@@ -88,16 +88,25 @@ def model_kind(arch: str) -> str:
 
 
 def create_model(arch: str, num_classes: int = 10, dtype=jnp.float32,
-                 pretrained=False, **kwargs):
+                 pretrained=False, warmstart_handled: bool = False,
+                 **kwargs):
     if pretrained is True:
         raise ValueError(
             "--pretrained without a path requires downloaded weights; this "
             "environment has no egress. Pass --pretrained PATH (a local "
             "checkpoint, e.g. an {arch}-model_best.msgpack from this repo) "
             "to warm-start, or train from scratch.")
-    # a str path is handled by the engines (params live outside the module
-    # in jax — the factory only builds architecture), so it is accepted
-    # here for signature parity and acted on in Trainer/LMTrainer.
+    if pretrained and not warmstart_handled:
+        # a str path is handled by the ENGINES (params live outside the
+        # module in jax — this factory only builds architecture); they pass
+        # warmstart_handled=True. Any other caller handing a path here
+        # would get a fresh-init model while believing it loaded weights —
+        # fail loudly instead of silently ignoring the request.
+        raise ValueError(
+            f"create_model does not load weights: pretrained={pretrained!r} "
+            "would be silently ignored. Use Trainer/LMTrainer (which graft "
+            "the checkpoint onto the init), or load it yourself via "
+            "engine.checkpoint.load_warmstart + graft_params.")
     kind = model_kind(arch)
     ctor = _REGISTRY[arch][0]
     if kind == "lm":

@@ -27,6 +27,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tpu_dist.ops.quant import make_dense
+
 
 def full_attention(q, k, v, *, causal: bool = True,
                    q_offset: int = 0, kv_offset: int = 0):
@@ -81,26 +83,31 @@ class Block(nn.Module):
     num_heads: int
     dtype: jnp.dtype = jnp.float32
     attn_fn: Callable = full_attention
+    quant: str = "none"  # none | int8 | int8_wo — dense/attention
+                         # projections via ops.quant (the attention
+                         # contraction itself and the norms stay fp)
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False):
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        qkv = nn.Dense(3 * d_model, use_bias=False, dtype=self.dtype,
-                       name="qkv")(h)
+        qkv = make_dense(3 * d_model, use_bias=False, dtype=self.dtype,
+                         name="qkv", quant=self.quant)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shp = (x.shape[0], x.shape[1], self.num_heads, head_dim)
         q, k, v = q.reshape(shp), k.reshape(shp), v.reshape(shp)
         out = attend_maybe_cached(self, q, k, v, decode=decode,
                                   attn_fn=self.attn_fn, dtype=self.dtype)
         out = out.reshape(x.shape)
-        x = x + nn.Dense(d_model, use_bias=False, dtype=self.dtype,
-                         name="proj")(out)
+        x = x + make_dense(d_model, use_bias=False, dtype=self.dtype,
+                           name="proj", quant=self.quant)(out)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        h = nn.Dense(4 * d_model, dtype=self.dtype, name="mlp_in")(h)
+        h = make_dense(4 * d_model, dtype=self.dtype, name="mlp_in",
+                       quant=self.quant)(h)
         h = nn.gelu(h)
-        x = x + nn.Dense(d_model, dtype=self.dtype, name="mlp_out")(h)
+        x = x + make_dense(d_model, dtype=self.dtype, name="mlp_out",
+                           quant=self.quant)(h)
         return x
 
 
@@ -117,6 +124,10 @@ class TransformerLM(nn.Module):
     remat: bool = False  # rematerialize each block's activations in the
                          # backward pass (jax.checkpoint): trades FLOPs for
                          # HBM — the long-context memory lever
+    quant: str = "none"  # none | int8 | int8_wo (ops.quant): int8 dense/
+                         # attention projections + lm_head; param tree is
+                         # IDENTICAL to the unquantized model, so the knob
+                         # composes with checkpoints and every sharding
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, pos_offset=0,
@@ -138,18 +149,19 @@ class TransformerLM(nn.Module):
                      else Block)
         for i in range(self.num_layers):
             x = block_cls(self.num_heads, self.dtype, self.attn_fn,
-                          name=f"block{i}")(x, train, decode)
+                          self.quant, name=f"block{i}")(x, train, decode)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_features:
             return x
-        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
-                          name="lm_head")(x)
+        logits = make_dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                            name="lm_head", quant=self.quant)(x)
         return logits.astype(jnp.float32)
 
 
 def tiny_lm(vocab_size=256, num_layers=2, d_model=64, num_heads=4,
             max_len=512, dtype=jnp.float32, attn_fn=full_attention,
-            remat=False, **_):
+            remat=False, quant="none", **_):
     return TransformerLM(vocab_size=vocab_size, num_layers=num_layers,
                         d_model=d_model, num_heads=num_heads, max_len=max_len,
-                        dtype=dtype, attn_fn=attn_fn, remat=remat)
+                        dtype=dtype, attn_fn=attn_fn, remat=remat,
+                        quant=quant)

@@ -39,6 +39,9 @@ class ViT(nn.Module):
     num_heads: int = 3
     dtype: jnp.dtype = jnp.float32
     attn_fn: Callable = partial(full_attention, causal=False)
+    quant: str = "none"  # none | int8 | int8_wo — quantized block matmuls
+                         # (ops.quant); the patch-embed conv and the tiny
+                         # classifier head stay in the compute dtype
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -56,7 +59,7 @@ class ViT(nn.Module):
                          (1, x.shape[1], self.d_model))
         x = x + pos.astype(self.dtype)
         for i in range(self.num_layers):
-            x = Block(self.num_heads, self.dtype, self.attn_fn,
+            x = Block(self.num_heads, self.dtype, self.attn_fn, self.quant,
                       name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(self.num_classes, dtype=self.dtype,

@@ -2,3 +2,6 @@ from tpu_dist.ops.optim import (  # noqa: F401
     lm_lr_schedule, make_optimizer, step_decay_schedule)
 from tpu_dist.ops.precision import (  # noqa: F401
     LossScaleState, Policy, make_policy, scale_loss, unscale_and_update)
+from tpu_dist.ops.quant import (  # noqa: F401
+    QUANT_MODES, QuantDense, quant_einsum, quant_matmul, quantize_int8,
+    validate_quant, wo_quantize_params)

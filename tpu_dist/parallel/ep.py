@@ -49,6 +49,8 @@ def ep_param_specs(params, axis: str = EXPERT_AXIS,
     def build(tree, path=()):
         if isinstance(tree, dict):
             return {k: build(v, path + (k,)) for k, v in tree.items()}
+        if path and path[-1].endswith("_scale"):
+            return P()  # weight-only int8 decode scales: tiny, replicated
         key = next((n for n in reversed(path) if n in names), "")
         return _moe_leaf_spec(key, tree, axis, model_axis)
     return build(params)

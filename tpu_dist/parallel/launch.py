@@ -93,6 +93,23 @@ def initialize(coordinator: Optional[str] = None,
             return LaunchInfo(None, 1, 0, "local")
         return LaunchInfo(None, jax.process_count(), jax.process_index(),
                           "tpu-metadata")
+    # the EFFECTIVE platform (the config value pinned above), not the env
+    # vars: TPU_DIST_PLATFORM=tpu must win over a leftover JAX_PLATFORMS=cpu,
+    # and a worker that pinned cpu via jax.config directly must still be
+    # caught. Unset means backend auto-detection — leave that path alone
+    # (reading the default backend here would initialize it prematurely).
+    effective = getattr(jax.config, "jax_platforms", None) or ""
+    if effective.split(",")[0] == "cpu" and info.num_processes > 1:
+        from tpu_dist._compat import CPU_MULTIPROCESS
+        if not CPU_MULTIPROCESS:
+            raise RuntimeError(
+                f"{info.num_processes}-process CPU run requested "
+                f"({info.method} rendezvous), but this jax "
+                f"({jax.__version__}) has no multi-process CPU "
+                "computations — every collective would die with "
+                "INVALID_ARGUMENT after rendezvous. Upgrade jax or run "
+                "single-process with virtual devices "
+                "(_compat.set_cpu_device_count).")
     jax.distributed.initialize(coordinator_address=info.coordinator,
                                num_processes=info.num_processes,
                                process_id=info.process_id)

@@ -39,7 +39,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from tpu_dist._compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_dist.engine.state import TrainState
@@ -175,6 +175,15 @@ def _pp_shard_map(mesh: Mesh, per_device, in_specs, out_specs,
     math Megatron-style over 'model' (pp x tp composition; round-2 gap)."""
     kwargs = {}
     if _uses_tp(mesh):
+        from tpu_dist._compat import PARTIAL_MANUAL_SHARD_MAP
+        if not PARTIAL_MANUAL_SHARD_MAP:
+            raise RuntimeError(
+                "pp x tp needs partial-manual shard_map (an auto 'model' "
+                "axis inside the manual pipeline program); this jax "
+                f"({jax.__version__}) only ships the experimental "
+                "shard_map, whose SPMD partitioner aborts on that "
+                "composition. Upgrade jax, or drop the 'model' axis "
+                "(plain pp) / the 'stage' axis (plain tp).")
         kwargs["axis_names"] = frozenset({data_axis, stage_axis})
     return shard_map(per_device, mesh=mesh, in_specs=in_specs,
                      out_specs=out_specs, check_vma=False, **kwargs)
@@ -203,6 +212,18 @@ def _clip_pp_grads(grads, grad_clip: float, stage_axis: str):
     return jax.tree.map(lambda g: g * scale, grads)
 
 
+def _head_logits(model, x, kernel, dtype):
+    """The last stage's lm_head matmul under the model's quant mode — the
+    same ops.quant treatment the non-pp head gets from make_dense, so the
+    pipeline run trains the SAME program per layer (the chunked-CE path
+    keeps its fp head in every mode, as documented on LMConfig.quant)."""
+    from tpu_dist.ops.quant import quant_matmul
+
+    quant = getattr(model, "quant", "none")
+    return quant_matmul(x.astype(dtype), kernel.astype(dtype),
+                        quant).astype(jnp.float32)
+
+
 def _stage_apply_builder(model):
     """(apply_stage, ln_f, dtype) shared by every pipeline schedule: the
     per-stage block scan (remat-aware) and the final-norm module — ONE
@@ -212,7 +233,8 @@ def _stage_apply_builder(model):
     from tpu_dist.models.transformer import Block
 
     block = Block(num_heads=model.num_heads, dtype=model.dtype,
-                  attn_fn=model.attn_fn)
+                  attn_fn=model.attn_fn,
+                  quant=getattr(model, "quant", "none"))
     ln_f = nn.LayerNorm(dtype=jnp.float32)
 
     def apply_stage(blocks_local, x):
@@ -242,7 +264,8 @@ def _stage_apply_aux_builder(model):
                      attn_fn=model.attn_fn,
                      router_top_k=model.router_top_k,
                      group_size=model.group_size,
-                     capacity_factor=model.capacity_factor)
+                     capacity_factor=model.capacity_factor,
+                     quant=getattr(model, "quant", "none"))
     ln_f = nn.LayerNorm(dtype=jnp.float32)
 
     def apply_stage(blocks_local, x):
@@ -381,9 +404,7 @@ def _pp_forward_builder(model, mesh: Mesh, num_microbatches: int,
                 # set cannot drift from the jit/sp paths
                 return _chunked_loss_metrics(model, eh, x, targets,
                                              mask, loss_chunk)
-            logits = (x.astype(dtype)
-                      @ eh["lm_head"]["kernel"].astype(dtype)
-                      ).astype(jnp.float32)
+            logits = _head_logits(model, x, eh["lm_head"]["kernel"], dtype)
             return lm_loss_and_metrics(logits, targets, mask)
 
         loss_sum, metrics = jax.lax.cond(
@@ -605,9 +626,8 @@ def _pp_1f1b_step_builder(model, tx, mesh: Mesh, num_microbatches: int,
                 loss_sum, metrics = _chunked_loss_metrics(
                     model, eh_p, x, tgt_mb[m], mask, loss_chunk)
             else:
-                logits = (x.astype(dtype)
-                          @ eh_p["lm_head"]["kernel"].astype(dtype)
-                          ).astype(jnp.float32)
+                logits = _head_logits(model, x, eh_p["lm_head"]["kernel"],
+                                      dtype)
                 loss_sum, metrics = lm_loss_and_metrics(logits, tgt_mb[m],
                                                         mask)
             # normalize by the FULL local shard so the M losses sum to the

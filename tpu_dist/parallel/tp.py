@@ -28,6 +28,12 @@ _RULES = (
 
 
 def _spec_for(path: str, leaf) -> P:
+    if path.endswith("_scale"):
+        # weight-only int8 decode scales (ops.quant.wo_quantize_params):
+        # one fp32 per output channel, with broadcast dims of size 1 that
+        # cannot shard — replicate (dequant distributes over the psum'd
+        # row-parallel partials, so replication is exact)
+        return P()
     for key, spec in _RULES:
         if key in path and leaf.ndim == len(spec):
             return spec
