@@ -57,6 +57,33 @@ ARCH = os.environ.get("BENCH_ARCH", "resnet50")
 NUM_CLASSES = int(os.environ.get("BENCH_NUM_CLASSES", "10"))
 
 
+def bench_ledger(kind: str, config: dict):
+    """(ledger, path) when BENCH_LEDGER names a JSONL path, else (None,
+    None): the bench feeds the SAME obs.ledger event stream the engines
+    write — run_start with the BENCH_* geometry, one 'step' per timed
+    trial with the dispatch/device phase split, run_end — so bench runs
+    are queryable with tools/ledger_report.py like any training run.
+    The LM bench emits live (plus a 'compile' event for the warm
+    dispatch); the image path constructs the ledger only after measure()
+    returns and emits its trial records retrospectively, so its 'ts'
+    stamps are end-of-run and it carries no 'compile' event."""
+    path = os.environ.get("BENCH_LEDGER", "")
+    if not path:
+        return None, None
+    import jax
+
+    from tpu_dist.obs import Ledger, effective_peak_tflops
+
+    eff_peak, nominal = effective_peak_tflops()
+    ledger = Ledger(path)
+    ledger.emit("run_start", kind=kind, config=config, mesh=None,
+                devices=sorted({d.device_kind for d in jax.local_devices()}),
+                process_count=jax.process_count(),
+                device_count=jax.device_count(),
+                peak_tflops=eff_peak, peak_is_nominal=nominal)
+    return ledger, path
+
+
 def lm_geometry():
     """(env-derived) LM bench geometry — THE single parse of the BENCH_*
     geometry knobs, shared by lm_build and profile_lm's parse-only path so
@@ -190,19 +217,45 @@ def lm_bench():
         print(f"xla cost model (diagnostic only): "
               f"{xla_flops / (batch * L / n_chips) / 1e6:.2f} MFLOP/token vs "
               f"analytical {flops_per_token / 1e6:.2f}", file=sys.stderr)
+    ledger, ledger_path = bench_ledger("bench_lm", lm_geometry())
+    t_warm = time.perf_counter()
     state, m = window(state, rows_dev, idx_dev, key)           # compile+warm
     jax.device_get(m)
-    rates = []
-    for _ in range(trials):
+    if ledger:
+        ledger.emit("compile", program="window_step",
+                    seconds=round(time.perf_counter() - t_warm, 3))
+    peak = peak_tflops_for(jax.devices()[0])
+    rates, phases = [], []
+    for i in range(trials):
         t0 = time.perf_counter()
         state, m = window(state, rows_dev, idx_dev, key)
+        disp_s = time.perf_counter() - t0
         jax.device_get(m)  # forces completion through the tunnel
-        rates.append(k * batch * L / (time.perf_counter() - t0))
+        dt = time.perf_counter() - t0
+        rates.append(k * batch * L / dt)
+        phases.append({"data_s": 0.0, "dispatch_s": round(disp_s, 6),
+                       "device_s": round(dt - disp_s, 6)})
+        if ledger:
+            # ledger MFU uses the engines' nominal-peak fallback (non-null
+            # on CPU); the headline JSON's mfu stays real-peak-only
+            from tpu_dist.obs import effective_peak_tflops
+            t_tf = rates[-1] / n_chips * flops_per_token / 1e12
+            ledger.emit("step", step=i, loss=None,
+                        throughput=round(rates[-1] / n_chips, 1),
+                        unit="tok/s/chip",
+                        mfu=t_tf / effective_peak_tflops()[0],
+                        steps_in_dispatch=k, data_s=0.0,
+                        dispatch_s=phases[-1]["dispatch_s"],
+                        device_s=phases[-1]["device_s"])
     best = max(rates)
+    best_phases = phases[rates.index(best)]
     tok_chip = best / n_chips
-    peak = peak_tflops_for(jax.devices()[0])
     tflops = tok_chip * flops_per_token / 1e12
     mfu = tflops / peak if peak else None
+    if ledger:
+        ledger.emit("run_end", steps=trials * k,
+                    seconds=round(time.perf_counter() - t_warm, 3))
+        ledger.close()
     print(f"lm {layers}L/d{d_model} L={L} b/chip={batch // n_chips} "
           f"attn={attn_kind}"
           + (f" loss_chunk={loss_chunk}" if loss_chunk else "")
@@ -226,6 +279,8 @@ def lm_bench():
         "vs_baseline": 1.0,
         "mfu": round(mfu, 4) if mfu else None,
         "tflops": round(tflops, 2) if tflops else None,
+        "phases": best_phases,
+        "ledger": ledger_path,
     }))
 
 
@@ -293,14 +348,19 @@ def measure(model_kwargs, per_chip_batch, k, trials):
     state, metrics = step(state, images, labels, key)
     jax.block_until_ready(metrics)
 
-    rates = []
+    rates, phases = [], []
     for _ in range(trials):
         t0 = time.perf_counter()
         state, metrics = step(state, images, labels, key)
+        disp_s = time.perf_counter() - t0
         jax.block_until_ready(metrics)
         dt = time.perf_counter() - t0
         rates.append(batch * k / dt)
-    return max(rates), sorted(rates), step_flops, batch
+        phases.append({"data_s": 0.0, "dispatch_s": round(disp_s, 6),
+                       "device_s": round(dt - disp_s, 6)})
+    best_phases = phases[rates.index(max(rates))]
+    return (max(rates), sorted(rates), step_flops, batch, best_phases,
+            list(zip(rates, phases)))  # trials in timing order, for the ledger
 
 
 def main():
@@ -359,7 +419,7 @@ def main():
                     res = measure({"cifar_stem": stem}, pcb,
                                   min(k, 40), max(2, trials // 2))
                     report(f"sweep stem={'cifar' if stem else 'imagenet'} "
-                           f"b/chip={pcb} k={min(k, 40)}", *res)
+                           f"b/chip={pcb} k={min(k, 40)}", *res[:4])
                 except Exception as e:
                     print(f"sweep stem={stem} b={pcb}: failed {e!r}",
                           file=sys.stderr)
@@ -404,10 +464,34 @@ def main():
                 f"ResNet knobs; unset them with BENCH_ARCH={ARCH}")
         kwargs = {}
         default_model = True
-    best, rates, window_flops, batch = measure(
+    best, rates, window_flops, batch, phases, trial_data = measure(
         kwargs, per_chip_batch, k, trials)
     ips_per_chip, tflops, mfu, fpi = report("headline", best, rates,
                                             window_flops, batch)
+    ledger, ledger_path = bench_ledger(
+        "bench_image", {"arch": ARCH, "img": IMG, "classes": NUM_CLASSES,
+                        "per_chip_batch": per_chip_batch, "k": k,
+                        **{kk: getattr(v, "__name__", str(v))
+                           for kk, v in kwargs.items()}})
+    if ledger:
+        # one 'step' per timed trial, in timing order — emitted
+        # retrospectively (measure() ran before the ledger existed); MFU
+        # vs the engines' effective peak (nominal fallback keeps it
+        # non-null on CPU — run_start carries peak_is_nominal)
+        from tpu_dist.obs import effective_peak_tflops
+        eff_peak = effective_peak_tflops()[0]
+        for i, (rate, ph) in enumerate(trial_data):
+            r_chip = rate / n_chips
+            tf = r_chip * fpi / 1e12 if fpi else None
+            ledger.emit("step", step=i, loss=None,
+                        throughput=round(r_chip, 1), unit="img/s/chip",
+                        mfu=round(tf / eff_peak, 6) if tf else None,
+                        steps_in_dispatch=k, data_s=0.0,
+                        dispatch_s=ph["dispatch_s"],
+                        device_s=ph["device_s"])
+        ledger.emit("run_end", steps=trials * k,
+                    seconds=round(sum(batch * k / r for r in rates), 3))
+        ledger.close()
 
     default_workload = (IMG == 32 and NUM_CLASSES == 10 and default_model
                         and ARCH == "resnet50")
@@ -427,6 +511,8 @@ def main():
             "mfu": round(mfu, 4) if mfu else None,
             "tflops": round(tflops, 2) if tflops else None,
             "flops_per_img": round(fpi) if fpi else None,
+            "phases": phases,
+            "ledger": ledger_path,
         }))
         return
 
@@ -458,6 +544,8 @@ def main():
         "mfu": round(mfu, 4) if mfu else None,
         "tflops": round(tflops, 2) if tflops else None,
         "flops_per_img": round(fpi) if fpi else None,
+        "phases": phases,
+        "ledger": ledger_path,
     }))
 
 

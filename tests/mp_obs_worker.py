@@ -1,0 +1,51 @@
+"""One worker of the 2-process skew-monitor test (tests/test_obs.py).
+
+Each process records synthetic per-step timings into obs.skew.SkewMonitor;
+process 1 reports an artificially slower step time, so the allgathered skew
+stats must finger process 1 as the straggler on EVERY process. Exercises
+the real cross-process ``multihost_utils.process_allgather`` path the
+single-process tests can't reach."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    out = os.environ["TPU_DIST_TEST_OUT"]
+    local_devices = int(os.environ.get("TPU_DIST_LOCAL_DEVICES", "2"))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tpu_dist._compat import set_cpu_device_count
+    set_cpu_device_count(local_devices)
+
+    from tpu_dist.parallel import launch
+
+    launch.initialize()
+    rank = jax.process_index()
+
+    from tpu_dist.obs.ledger import Ledger, per_process_path
+    from tpu_dist.obs.skew import SkewMonitor
+
+    ledger = Ledger(per_process_path(os.path.join(out, "skew.jsonl"), rank),
+                    process_index=rank)
+    mon = SkewMonitor(every=2, ledger=ledger)
+    # process 1 is the injected straggler: 3x the step time, more data wait
+    step_s = 0.010 if rank == 0 else 0.030
+    stats = None
+    for step in range(4):
+        s = mon.record(step, step_s, data_s=step_s / 2)
+        stats = s or stats
+    ledger.close()
+    assert stats is not None, "no exchange happened"
+    with open(os.path.join(out, f"skew-result-{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "stats": stats,
+                   "process_count": jax.process_count()}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,31 @@ def test_meter_bank_progress_line_format():
     assert lines == ["Epoch: [3][  7/100]\tLoss 1.50 (1.50)"]
 
 
+def test_meter_bank_snapshot_agrees_with_printed_line():
+    """snapshot() is THE read both the progress printer and the run ledger
+    consume (round-6 obs satellite): the numbers in the rendered line must
+    be exactly the snapshot's (last, avg) — line() renders FROM the
+    snapshot, so a drift is structurally impossible; this pins it."""
+    b = MeterBank(50, [("Loss", ".4f"), ("Time", "6.3f")], prefix="E[0]")
+    for v, n in ((2.0, 4), (1.0, 4), (0.5, 8)):
+        b.update("Loss", v, n)
+        b.update("Time", v / 10, 1)
+    snap = b.snapshot()
+    assert snap["Loss"]["last"] == 0.5
+    assert snap["Loss"]["avg"] == (2.0 * 4 + 1.0 * 4 + 0.5 * 8) / 16
+    line = b.line(7)
+    # the rendered cells carry the snapshot's numbers, formatted
+    assert f"Loss {snap['Loss']['last']:.4f} ({snap['Loss']['avg']:.4f})" \
+        in line
+    assert f"Time {snap['Time']['last']:6.3f} ({snap['Time']['avg']:6.3f})" \
+        in line
+    # rendering an explicitly passed snapshot equals the implicit read
+    assert b.line(7, snapshot=snap) == line
+    # snapshot is a copy: mutating it cannot corrupt the meters
+    snap["Loss"]["last"] = 999.0
+    assert b.last("Loss") == 0.5
+
+
 def test_meter_bank_avg_independent_of_update_batching():
     # summing one window at a time must equal per-sample updates
     a = MeterBank(10, [("x", ".2f")])

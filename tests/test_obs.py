@@ -1,0 +1,397 @@
+"""Observability subsystem (round 6): ledger / tracer / skew / watchdog.
+
+Covers: schema round-trip for every declared event type, tracer span
+nesting + accumulation, watchdog firing on an injected stall (and staying
+silent on a healthy loop) WITHOUT killing the run, the skew monitor's
+straggler math (single-process inline; 2 real processes via mp_obs_worker
+behind the CPU_MULTIPROCESS gate), both engines' CPU smoke runs producing
+fully-populated step records, the epoch-CSV-as-sink parity, and the static
+schema checker as a plain test (tier-1 schema-drift tripwire)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_dist.obs import (EVENT_SCHEMA, EpochCsvSink, Ledger, ProgressSink,
+                          SkewMonitor, StepTracer, Watchdog,
+                          per_process_path, phase_totals, read_ledger)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- ledger
+def _required_stub(event):
+    """A value for every required field of ``event`` (None is legal)."""
+    return {k: None for k in EVENT_SCHEMA[event]}
+
+
+def test_ledger_schema_roundtrip_every_event(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    led = Ledger(path)
+    for event in EVENT_SCHEMA:
+        led.emit(event, **_required_stub(event))  # ledger-schema: forward
+    led.close()
+    recs = read_ledger(path)  # validates: declared event + required fields
+    assert [r["event"] for r in recs] == list(EVENT_SCHEMA)
+    for r in recs:
+        assert r["ts"] > 0 and r["pid"] == 0
+
+
+def test_ledger_run_start_captures_config_and_mesh(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    led = Ledger(path)
+    led.emit("run_start", kind="test", config={"lr": 0.1, "arch": "lenet"},
+             mesh={"data": 4, "model": 2}, devices=["cpu"], process_count=1)
+    led.close()
+    (rec,) = read_ledger(path)
+    assert rec["config"]["arch"] == "lenet"
+    assert rec["mesh"] == {"data": 4, "model": 2}
+
+
+def test_ledger_rejects_undeclared_event_and_missing_fields(tmp_path):
+    led = Ledger(str(tmp_path / "x.jsonl"))
+    with pytest.raises(ValueError, match="undeclared"):
+        led.emit("not_an_event", foo=1)  # ledger-schema: forward
+    with pytest.raises(ValueError, match="missing required"):
+        led.emit("step", step=0)  # ledger-schema: forward
+    led.close()
+
+
+def test_ledger_pathless_sink_only_and_thread_safe():
+    seen = []
+    led = Ledger(None)
+    led.add_sink(seen.append)
+
+    def spam():
+        for i in range(50):
+            led.emit("hbm", bytes_in_use=i)
+
+    threads = [threading.Thread(target=spam) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(seen) == 200
+    assert led.last["event"] == "hbm"
+    led.close()
+
+
+def test_per_process_path():
+    assert per_process_path("run.jsonl", 0) == "run.jsonl"
+    assert per_process_path("run.jsonl", 3) == "run.p3.jsonl"
+    assert per_process_path("/a/b/tele.csv", 1) == "/a/b/tele.p1.csv"
+    assert per_process_path("", 2) == ""
+
+
+def test_epoch_csv_sink_renders_legacy_row(tmp_path):
+    """The cookbook-parity CSV row is a VIEW of the ledger's epoch event:
+    [wall_start, seconds, rate, hbm] — identical to what the loops wrote
+    inline through round 5."""
+    import csv
+
+    path = str(tmp_path / "ep.csv")
+    led = Ledger(None)
+    led.add_sink(EpochCsvSink(path))
+    led.emit("epoch", epoch=0, start_ts=123.5, seconds=7.25,
+             throughput=1234.56, unit="img/s", loss=0.5, hbm_bytes=999)
+    led.emit("epoch", epoch=1, start_ts=130.75, seconds=6.0,
+             throughput=2000.0, unit="img/s", loss=0.4, hbm_bytes=None)
+    led.close()
+    rows = list(csv.reader(open(path)))
+    assert rows == [["123.5", "7.25", "1234.6", "999"],
+                    ["130.75", "6.0", "2000.0", ""]]
+
+
+def test_all_none_records_render_without_crashing(tmp_path):
+    """The schema pins PRESENCE, not non-nullness — every renderer
+    (ProgressSink, ledger_report.summarize) must survive records whose
+    required fields are all None (e.g. a backend with no counters)."""
+    path = str(tmp_path / "n.jsonl")
+    led = Ledger(path)
+    for event in EVENT_SCHEMA:
+        led.emit(event, **_required_stub(event))  # ledger-schema: forward
+    led.close()
+    recs = read_ledger(path)
+    sink = ProgressSink(printer=lambda s: None)
+    for r in recs:
+        sink(r)
+    from tools.ledger_report import summarize
+
+    summarize(recs, out=lambda s: None)
+
+
+def test_watchdog_beat_derives_interdrain_durations():
+    """beat() (the loops' drain-point signal) self-derives durations: the
+    first beat only arms, later beats append the inter-beat gap, and a
+    beat after pause() re-arms without polluting the median with the
+    eval-phase gap."""
+    wd = Watchdog(factor=2.0, min_timeout_s=0.01, poll_s=5.0)
+    wd.beat()  # arms only
+    assert len(wd._durations) == 0
+    time.sleep(0.05)
+    wd.beat()
+    assert len(wd._durations) == 1 and wd._durations[0] >= 0.04
+    wd.pause()
+    time.sleep(0.1)  # an eval-sized gap that must NOT enter the median
+    wd.beat()
+    assert len(wd._durations) == 1  # re-armed, no new duration
+    time.sleep(0.03)
+    wd.beat()
+    assert len(wd._durations) == 2 and wd._durations[-1] < 0.09
+    wd.stop()
+
+
+def test_progress_sink_renders_step_line():
+    lines = []
+    sink = ProgressSink(printer=lines.append)
+    sink({"event": "step", "step": 3, "loss": 1.25, "throughput": 1000.0,
+          "unit": "tok/s", "mfu": 0.5, "data_s": 0.1, "dispatch_s": 0.2,
+          "device_s": 0.3})
+    assert "step 3" in lines[0] and "MFU 50.0%" in lines[0]
+    assert "1,000 tok/s" in lines[0]
+
+
+# ---------------------------------------------------------------- tracer
+def test_tracer_span_nesting_and_accumulation():
+    tr = StepTracer()
+    with tr.span("data"):
+        time.sleep(0.02)
+        with tr.span("decode"):
+            time.sleep(0.02)
+    with tr.span("data"):  # accumulates into the same key
+        time.sleep(0.01)
+    ph = tr.pop()
+    assert set(ph) == {"data", "data/decode"}
+    # parent includes the child (wall-clock truth), second span adds on
+    assert ph["data"] >= ph["data/decode"] >= 0.02
+    assert ph["data"] >= 0.03
+    # pop() reset
+    assert tr.pop() == {}
+    tr.add("device", 1.5)
+    tr.add("device", 0.5)
+    assert tr.pop() == {"device": 2.0}
+
+
+def test_tracer_span_annotation_flag_off_by_default():
+    # annotate=False must not import/require a live profiler
+    tr = StepTracer(annotate=False)
+    with tr.span("dispatch"):
+        pass
+    assert "dispatch" in tr.pop()
+
+
+# -------------------------------------------------------------- watchdog
+def test_watchdog_fires_on_stall_without_killing_run(tmp_path):
+    import io
+
+    path = str(tmp_path / "wd.jsonl")
+    led = Ledger(path)
+    err = io.StringIO()
+    wd = Watchdog(factor=2.0, ledger=led, min_timeout_s=0.05, poll_s=0.02,
+                  stream=err)
+    for _ in range(5):
+        wd.step_done(0.02)
+    time.sleep(0.5)  # the injected stall: no step completes
+    assert wd.stall_count == 1  # fired ONCE per stall, not per poll
+    dump = err.getvalue()
+    assert "NO STEP COMPLETED" in dump
+    assert "tpu-dist-watchdog" not in dump.split("--- thread")[0]
+    assert "--- thread" in dump  # stack dump includes thread frames
+    # the run is NOT killed: stepping resumes and re-arms cleanly
+    wd.step_done(0.02)
+    time.sleep(0.1)
+    assert wd.stall_count == 2  # a second stall fires again after re-arm
+    wd.stop()
+    led.close()
+    stalls = [r for r in read_ledger(path) if r["event"] == "stall"]
+    assert len(stalls) == 2
+    assert stalls[0]["idle_s"] >= 0.05
+    assert "--- thread" in stalls[0]["stacks"]
+
+
+def test_watchdog_silent_on_healthy_loop_and_when_paused(tmp_path):
+    led = Ledger(str(tmp_path / "wd2.jsonl"))
+    wd = Watchdog(factor=2.0, ledger=led, min_timeout_s=0.05, poll_s=0.02)
+    for _ in range(20):  # healthy cadence well under the threshold
+        wd.step_done(0.01)
+        time.sleep(0.01)
+    assert wd.stall_count == 0
+    wd.pause()  # eval/ckpt phase: no steps complete, by design
+    time.sleep(0.3)
+    assert wd.stall_count == 0
+    wd.stop()
+    led.close()
+    assert not [r for r in read_ledger(led.path) if r["event"] == "stall"]
+
+
+# ------------------------------------------------------------------ skew
+def test_skew_monitor_single_process(tmp_path):
+    led = Ledger(str(tmp_path / "skew.jsonl"))
+    mon = SkewMonitor(every=3, ledger=led)
+    assert mon.record(0, 0.01) is None  # not at the boundary yet
+    assert mon.record(1, 0.01) is None
+    stats = mon.record(2, 0.02, data_s=0.005)
+    assert stats is not None
+    assert stats["straggler"] == 0 and stats["n_procs"] == 1
+    assert stats["spread_s"] == 0.0
+    assert stats["p50_s"] == pytest.approx(np.mean([0.01, 0.01, 0.02]))
+    led.close()
+    (rec,) = [r for r in read_ledger(led.path) if r["event"] == "skew"]
+    assert rec["step"] == 2 and rec["straggler"] == 0
+
+
+def test_skew_monitor_two_real_processes(tmp_path):
+    """Straggler detection over an actual process boundary: process 1
+    reports 3x step times; every process's allgathered stats must agree
+    that process 1 is the straggler (reuses the mp_worker spawn pattern)."""
+    from tpu_dist._compat import CPU_MULTIPROCESS
+    if not CPU_MULTIPROCESS:
+        pytest.skip("this jax's CPU backend has no multi-process "
+                    "computations (_compat.CPU_MULTIPROCESS)")
+    from test_multiprocess import run_workers  # tests/ is on sys.path
+
+    worker = os.path.join(ROOT, "tests", "mp_obs_worker.py")
+    outdir = run_workers(str(tmp_path), "skew", nprocs=2, local_devices=2,
+                         worker=worker)
+    for rank in (0, 1):
+        with open(os.path.join(outdir, f"skew-result-{rank}.json")) as f:
+            res = json.load(f)
+        assert res["process_count"] == 2
+        assert res["stats"]["n_procs"] == 2
+        assert res["stats"]["straggler"] == 1  # the injected slow process
+        assert res["stats"]["spread_s"] == pytest.approx(0.020, abs=1e-6)
+    # each process wrote its OWN ledger file (.pN suffix for non-main)
+    assert os.path.exists(os.path.join(outdir, "skew.jsonl"))
+    assert os.path.exists(os.path.join(outdir, "skew.p1.jsonl"))
+
+
+# -------------------------------------------------- engine smoke (CPU)
+def _assert_step_records_complete(recs, unit):
+    steps = [r for r in recs if r["event"] == "step"]
+    assert steps, "no step events in ledger"
+    for r in steps:
+        for k in ("data_s", "dispatch_s", "device_s", "mfu", "throughput",
+                  "loss"):
+            assert r[k] is not None, (k, r)
+        assert r["unit"] == unit
+    assert phase_totals(steps)["dispatch_s"] > 0
+    return steps
+
+
+def _assert_run_shape(recs):
+    events = [r["event"] for r in recs]
+    assert events[0] == "run_start" and events[-1] == "run_end"
+    assert "compile" in events and "epoch" in events and "eval" in events
+    run = recs[0]
+    assert run["config"] and run["devices"] and run["mesh"]
+
+
+def test_image_engine_ledger_smoke(tmp_path):
+    """Acceptance: a CPU run of the image engine with ledger_path set
+    yields step records with non-null phase breakdown, MFU and throughput,
+    and tools/ledger_report renders the file."""
+    from tpu_dist.configs import TrainConfig
+    from tpu_dist.engine.loop import Trainer
+
+    path = str(tmp_path / "img.jsonl")
+    cfg = TrainConfig(arch="lenet", dataset="synthetic", epochs=1,
+                      batch_size=16, workers=1, print_freq=2, seed=0,
+                      synth_train_size=64, synth_val_size=32,
+                      checkpoint_dir=str(tmp_path / "ck"),
+                      ledger_path=path, log_csv=str(tmp_path / "ep.csv"),
+                      skew_every=2)
+    Trainer(cfg).fit()
+    recs = read_ledger(path)
+    _assert_run_shape(recs)
+    _assert_step_records_complete(recs, "img/s")
+    assert [r for r in recs if r["event"] == "skew"]
+    assert [r for r in recs if r["event"] == "ckpt"]
+    # the legacy CSV rendered as a sink, same values as the epoch event
+    import csv
+
+    (ep,) = [r for r in recs if r["event"] == "epoch"]
+    (row,) = list(csv.reader(open(tmp_path / "ep.csv")))
+    assert float(row[0]) == pytest.approx(ep["start_ts"])
+    assert float(row[2]) == pytest.approx(round(ep["throughput"], 1))
+    # the report tool renders it
+    from tools.ledger_report import summarize
+
+    lines = []
+    counts = summarize(recs, out=lines.append)
+    assert counts["steps"] > 0 and counts["epochs"] == 1
+    assert any("phase time share" in ln for ln in lines)
+
+
+def test_lm_engine_ledger_smoke(tmp_path):
+    """Acceptance twin for the LM engine, windowed (K>1) path included."""
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    path = str(tmp_path / "lm.jsonl")
+    cfg = LMConfig(epochs=1, batch_size=8, seq_len=32, vocab_size=64,
+                   num_layers=1, d_model=32, num_heads=2, synth_tokens=4096,
+                   print_freq=4, seed=0, steps_per_dispatch=3,
+                   ledger_path=path)
+    LMTrainer(cfg).fit()
+    recs = read_ledger(path)
+    _assert_run_shape(recs)
+    steps = _assert_step_records_complete(recs, "tok/s")
+    # the windowed path records K-step dispatches
+    assert max(r["steps_in_dispatch"] for r in steps) == 3
+    (ep,) = [r for r in recs if r["event"] == "epoch"]
+    assert ep["unit"] == "tok/s" and ep["ppl"] > 0
+
+
+def test_generate_ledger_decode_event(tmp_path):
+    import jax.numpy as jnp
+
+    from tpu_dist.engine.generate import generate
+    from tpu_dist.models.transformer import tiny_lm
+
+    model = tiny_lm(vocab_size=32, num_layers=1, d_model=16, num_heads=2,
+                    max_len=16)
+    import jax
+
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 16), jnp.int32),
+                        train=False)["params"]
+    led = Ledger(str(tmp_path / "gen.jsonl"))
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    out = generate(model, params, prompt, steps=5, ledger=led)
+    led.close()
+    assert out.shape == (2, 9)
+    (rec,) = [r for r in read_ledger(led.path) if r["event"] == "decode"]
+    assert rec["tokens"] == 10 and rec["throughput"] > 0
+    assert rec["dispatch_s"] >= 0 and rec["device_s"] >= 0
+
+
+# ------------------------------------------------------- static checker
+def test_check_ledger_schema_tree_is_clean():
+    """Tier-1 tripwire: every ledger.emit call site in the tree names a
+    declared event and passes its required fields (AST walk, no jax)."""
+    from tools.check_ledger_schema import check_tree, load_schema
+
+    assert load_schema() == EVENT_SCHEMA  # AST extraction == runtime dict
+    assert check_tree() == []
+
+
+def test_check_ledger_schema_catches_drift(tmp_path):
+    """The checker actually rejects: undeclared events, computed event
+    names, and required fields hidden in a **splat."""
+    from tools.check_ledger_schema import check_file, load_schema
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "ledger.emit('no_such_event', x=1)\n"
+        "ledger.emit(name, step=1)\n"
+        "ledger.emit('step', **fields)\n"
+        "self.obs.ledger.emit('ckpt', epoch=1, path='p', is_best=False)\n")
+    out = check_file(str(bad), load_schema(), "bad.py")
+    assert len(out) == 3  # the last line is conformant
+    assert any("undeclared" in v for v in out)
+    assert any("literal" in v for v in out)
+    assert any("missing required" in v for v in out)

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Static ledger-schema check: every ``*.emit(...)`` call site conforms.
+
+Walks the tree's Python ASTs (no imports of jax — or of anything else from
+the checked modules: the schema itself is extracted from
+``tpu_dist/obs/ledger.py`` by AST too) and verifies, for every call of the
+form ``<something named ...ledger...>.emit(...)``:
+
+* the event name is a LITERAL string naming a declared ``EVENT_SCHEMA``
+  event (a computed event name defeats static checking — declare a new
+  event instead);
+* every required field of that event appears as an explicit keyword (a
+  bare ``**fields`` splat hides required fields from the checker, so only
+  the NON-required extras may ride in a splat — except for forwarding
+  wrappers that re-expose ``emit``'s own signature, which declare
+  themselves via a ``# ledger-schema: forward`` comment on the call line).
+
+Wired into tier-1 as a plain test (tests/test_obs.py) so schema drift —
+a renamed field, an undeclared event — fails fast at review time, not at
+3am when someone greps a ledger.
+
+CLI: ``python tools/check_ledger_schema.py [root]`` — prints violations,
+exits non-zero if any.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMA_FILE = os.path.join("tpu_dist", "obs", "ledger.py")
+# directories whose .py files are checked (tests included: a test emitting
+# a drifted record would otherwise pin the drift as "expected")
+CHECKED = ("tpu_dist", "tools", "tests", "scripts")
+CHECKED_FILES = ("bench.py",)
+FORWARD_MARK = "ledger-schema: forward"
+
+
+def load_schema(root: str = ROOT) -> dict:
+    """EVENT_SCHEMA extracted from ledger.py source by AST — the dict is a
+    pure literal by contract (see its definition comment)."""
+    src = open(os.path.join(root, SCHEMA_FILE)).read()
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "EVENT_SCHEMA":
+                    return ast.literal_eval(node.value)
+    raise AssertionError(f"EVENT_SCHEMA literal not found in {SCHEMA_FILE}")
+
+
+def _terminal_name(func_value) -> str:
+    """The receiver's final name: ``self.obs.ledger`` -> 'ledger',
+    ``led`` -> 'led'."""
+    node = func_value
+    while isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_ledger_emit(call: ast.Call) -> bool:
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "emit"):
+        return False
+    name = _terminal_name(f.value).lower()
+    # 'led' included: the natural short name must not dodge the checker
+    return "ledger" in name or name == "led"
+
+
+def check_file(path: str, schema: dict, rel: str) -> list:
+    src = open(path).read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{rel}: unparseable ({e})"]
+    lines = src.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_ledger_emit(node)):
+            continue
+        where = f"{rel}:{node.lineno}"
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if FORWARD_MARK in line:
+            continue  # declared forwarding wrapper (re-exposes emit())
+        if not node.args:
+            out.append(f"{where}: emit() without an event argument")
+            continue
+        ev = node.args[0]
+        if not (isinstance(ev, ast.Constant) and isinstance(ev.value, str)):
+            out.append(f"{where}: event name must be a literal string "
+                       "(static checkability)")
+            continue
+        required = schema.get(ev.value)
+        if required is None:
+            out.append(f"{where}: undeclared event {ev.value!r} "
+                       f"(EVENT_SCHEMA: {sorted(schema)})")
+            continue
+        kw = {k.arg for k in node.keywords if k.arg is not None}
+        missing = [f for f in required if f not in kw]
+        if missing:
+            out.append(f"{where}: event {ev.value!r} missing required "
+                       f"keyword(s) {missing}")
+    return out
+
+
+def check_tree(root: str = ROOT) -> list:
+    schema = load_schema(root)
+    violations = []
+    targets = []
+    for d in CHECKED:
+        for dirpath, _, files in os.walk(os.path.join(root, d)):
+            targets += [os.path.join(dirpath, f) for f in files
+                        if f.endswith(".py")]
+    targets += [os.path.join(root, f) for f in CHECKED_FILES]
+    for path in sorted(targets):
+        if not os.path.exists(path):
+            continue
+        rel = os.path.relpath(path, root)
+        violations += check_file(path, schema, rel)
+    return violations
+
+
+def main(argv=None) -> int:
+    root = (argv or sys.argv[1:] or [ROOT])[0]
+    violations = check_tree(root)
+    for v in violations:
+        print(v, file=sys.stderr)
+    print(f"check_ledger_schema: {len(violations)} violation(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

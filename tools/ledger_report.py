@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Summarize a tpu_dist run ledger (obs.ledger JSONL) from the CLI.
+
+    python tools/ledger_report.py run.jsonl            # summary
+    python tools/ledger_report.py run.jsonl --tail 20  # + last N step lines
+
+Renders: run identity (kind/mesh/devices/processes), per-phase time share
+(data wait vs dispatch vs device block across every step record), MFU and
+throughput trend (first/middle/last thirds), the epoch table, cross-host
+skew/straggler summary, and any watchdog stall dumps. Pure stdlib + the
+ledger module — safe to run on a login host with no jax installed
+(obs.ledger imports nothing heavy).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_dist.obs.ledger import ProgressSink, phase_totals, read_ledger  # noqa: E402
+
+
+def _mean(xs):
+    xs = list(xs)
+    return sum(xs) / len(xs) if xs else None
+
+
+def _fmt_mfu(x):
+    return f"{x * 100:.1f}%" if x is not None else "n/a"
+
+
+def _num(v, spec):
+    """None-tolerant numeric cell ('?' for a schema-legal null)."""
+    return f"{v:{spec}}" if v is not None else "?"
+
+
+def _thirds(xs):
+    """(first, middle, last) third means — the cheap trend view."""
+    if not xs:
+        return None, None, None
+    n = max(len(xs) // 3, 1)
+    return _mean(xs[:n]), _mean(xs[len(xs) // 2 - n // 2:
+                                   len(xs) // 2 - n // 2 + n]), _mean(xs[-n:])
+
+
+def summarize(records, out=print):
+    runs = [r for r in records if r["event"] == "run_start"]
+    steps = [r for r in records if r["event"] == "step"]
+    epochs = [r for r in records if r["event"] == "epoch"]
+    evals = [r for r in records if r["event"] == "eval"]
+    skews = [r for r in records if r["event"] == "skew"
+             and r.get("spread_s") is not None]
+    stalls = [r for r in records if r["event"] == "stall"]
+    ends = [r for r in records if r["event"] == "run_end"]
+
+    for r in runs:
+        out(f"run: kind={r['kind']} devices={r.get('devices')} "
+            f"mesh={r.get('mesh')} processes={r.get('process_count')}"
+            + (" (MFU vs NOMINAL peak)" if r.get("peak_is_nominal") else ""))
+    if ends:
+        secs = ends[-1]["seconds"]
+        out(f"completed: {ends[-1]['steps']} steps in "
+            + (f"{secs:.1f}s" if secs is not None else "?s")
+            + "".join(f" {k}={v}" for k, v in ends[-1].items()
+                      if k not in ("event", "ts", "pid", "steps", "seconds")))
+
+    if steps:
+        # warm records carry the XLA compile in dispatch_s; exclude them
+        # from shares/trends (the loops' own warm-excluded tok/s
+        # convention) — the compile cost lives in the 'compile' event
+        warm_n = sum(1 for r in steps if r.get("warm"))
+        hot = [r for r in steps if not r.get("warm")] or steps
+        tot = phase_totals(hot)
+        total = sum(tot.values()) or 1.0
+        out(f"\nsteps: {sum(r.get('steps_in_dispatch') or 1 for r in steps)} "
+            f"optimizer steps in {len(steps)} records"
+            + (f" ({warm_n} warm/compile record(s) excluded from shares)"
+               if warm_n and hot is not steps else ""))
+        out("phase time share (host-measured):")
+        for k, label in (("data_s", "data wait"), ("dispatch_s", "dispatch"),
+                         ("device_s", "device block")):
+            out(f"  {label:<13} {tot[k]:9.3f}s  {tot[k] / total * 100:5.1f}%")
+        tp = [r["throughput"] for r in hot if r["throughput"] is not None]
+        mfu = [r["mfu"] for r in hot if r["mfu"] is not None]
+        a, b, c = _thirds(tp)
+        if a is not None:
+            out(f"throughput ({hot[0]['unit']}): first/mid/last thirds "
+                f"{a:,.0f} / {b:,.0f} / {c:,.0f}")
+        a, b, c = _thirds(mfu)
+        if a is not None:
+            out(f"MFU trend: {_fmt_mfu(a)} -> {_fmt_mfu(b)} -> {_fmt_mfu(c)}"
+                f"  (mean {_fmt_mfu(_mean(mfu))})")
+
+    if epochs:
+        out("\nepochs:")
+        for r in epochs:
+            # schema-legal None values render as '?' (presence, not
+            # non-nullness, is what the schema pins)
+            out(f"  [{r['epoch']}] loss=" + _num(r["loss"], ".4f")
+                + f" {_num(r['throughput'], ',.0f')} {r['unit']} "
+                f"({_num(r['seconds'], '.1f')}s)"
+                + (f" ppl={r['ppl']:.2f}" if r.get("ppl") else "")
+                + (f" acc1={r['acc1'] * 100:.2f}%" if r.get("acc1") is not None
+                   else ""))
+    if evals:
+        last = evals[-1]
+        out("last eval: loss=" + _num(last["loss"], ".4f")
+            + (f" ppl={last['ppl']:.2f}" if last.get("ppl") else "")
+            + (f" acc1={last['acc1'] * 100:.2f}%"
+               if last.get("acc1") is not None else ""))
+
+    if skews:
+        worst = max(skews, key=lambda r: r["spread_s"])
+        hist = {}
+        for r in skews:
+            hist[r["straggler"]] = hist.get(r["straggler"], 0) + 1
+        out(f"\nskew: {len(skews)} samples; worst spread "
+            f"{worst['spread_s'] * 1e3:.1f}ms at step {worst['step']} "
+            f"(straggler process {worst['straggler']}); "
+            f"p50 {worst['p50_s'] * 1e3:.1f}ms p99 {worst['p99_s'] * 1e3:.1f}ms")
+        out(f"straggler histogram (process: samples): {hist}")
+
+    if stalls:
+        out(f"\nWATCHDOG STALLS: {len(stalls)}")
+        for r in stalls:
+            out(f"  idle {_num(r['idle_s'], '.1f')}s (threshold "
+                f"{_num(r['threshold_s'], '.1f')}s) — first stack lines:")
+            for line in (r.get("stacks") or "").splitlines()[:6]:
+                out(f"    {line}")
+    return {"steps": len(steps), "epochs": len(epochs), "skews": len(skews),
+            "stalls": len(stalls)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="ledger JSONL (obs.ledger)")
+    ap.add_argument("--tail", type=int, default=0,
+                    help="also render the last N step records as lines")
+    args = ap.parse_args(argv)
+    records = read_ledger(args.path)
+    if not records:
+        print(f"{args.path}: empty ledger", file=sys.stderr)
+        return 1
+    summarize(records)
+    if args.tail:
+        print(f"\nlast {args.tail} step records:")
+        sink = ProgressSink()
+        for r in [r for r in records if r["event"] == "step"][-args.tail:]:
+            sink(r)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # `ledger_report run.jsonl | head` closing the pipe is normal use
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        raise SystemExit(0)

@@ -105,12 +105,26 @@ class TrainConfig:
                                        # (auto: device when in-memory and
                                        # steps_per_dispatch > 1)
 
-    # -- observability (reference C21/C22)
+    # -- observability (reference C21/C22 + the round-6 obs subsystem)
     log_csv: str = ""                  # per-epoch [start, seconds] CSV if set
+                                       # (rendered as a ledger sink since
+                                       # round 6 — same values, one source)
     profile_dir: str = ""              # jax.profiler trace dir if set
     telemetry_csv: str = ""            # 500ms device-HBM/host-RSS sampler CSV
                                        # (utils.telemetry — the reference's
-                                       # nvidia-smi statistics.sh analog)
+                                       # nvidia-smi statistics.sh analog;
+                                       # every process writes its own
+                                       # .pN-suffixed file on multi-host)
+    ledger_path: str = ""              # append-only JSONL run ledger
+                                       # (obs.ledger: run_start/step/epoch/
+                                       # eval/ckpt/... typed events; non-main
+                                       # processes write <path>.pN)
+    watchdog_factor: float = 10.0      # hang watchdog (obs.watchdog): dump
+                                       # stacks+HBM when no step completes in
+                                       # factor x trailing-median step time
+                                       # (5s floor; 0 disables)
+    skew_every: int = 0                # cross-host step-time skew allgather
+                                       # every K steps (obs.skew; 0 = off)
 
     # -- synthetic-data knobs (TPU-only: zero-egress envs can't download datasets)
     synth_train_size: int = 50000
@@ -226,9 +240,15 @@ class LMConfig:
     pretrained: str = ""           # warm-start params from a local ckpt
                                    # (fresh opt state; see TrainConfig)
     checkpoint_dir: str = ""
-    log_csv: str = ""
+    log_csv: str = ""              # per-epoch CSV (ledger sink since round 6)
     profile_dir: str = ""          # jax.profiler trace dir if set (C22)
-    telemetry_csv: str = ""        # 500ms device-HBM sampler (utils.telemetry)
+    telemetry_csv: str = ""        # 500ms device-HBM sampler (utils.telemetry;
+                                   # .pN-suffixed per process on multi-host)
+    ledger_path: str = ""          # JSONL run ledger (obs.ledger; non-main
+                                   # processes write <path>.pN)
+    watchdog_factor: float = 10.0  # hang watchdog: factor x trailing-median
+                                   # step time (5s floor; 0 disables)
+    skew_every: int = 0            # cross-host skew allgather every K steps
 
 
 def add_args(parser: argparse.ArgumentParser, defaults) -> None:

@@ -17,6 +17,7 @@ sample from the model they trained. TPU-first constraints shape the design:
 
 from __future__ import annotations
 
+import time
 import weakref
 from functools import lru_cache
 from typing import Optional
@@ -54,7 +55,8 @@ def generate(model, params, prompt: jax.Array, steps: int,
              use_cache: bool = False,
              top_k: int = 0, top_p: float = 0.0,
              mesh: Optional[Mesh] = None,
-             quant: str = "none") -> jax.Array:
+             quant: str = "none",
+             ledger=None) -> jax.Array:
     """Continue ``prompt`` (B, P) int32 by ``steps`` tokens.
 
     temperature 0 = greedy argmax (deterministic); > 0 = categorical over
@@ -96,6 +98,11 @@ def generate(model, params, prompt: jax.Array, steps: int,
     same cache. The decode tick is weight-bandwidth-bound (BASELINE.md
     decode section: ~340 MB params/tick at 0.9B), exactly the regime where
     TP's 1/n_model weight traffic per chip cuts ms/token.
+
+    ``ledger`` (an :class:`tpu_dist.obs.ledger.Ledger`) records the call as
+    one ``decode`` event — tokens, wall seconds, tok/s, dispatch vs
+    device-block split. Observability implies a sync: the buffer is blocked
+    on before returning (the same array is returned, now ready).
     """
     b, p = prompt.shape
     if steps <= 0:
@@ -134,11 +141,26 @@ def generate(model, params, prompt: jax.Array, steps: int,
                                  _cache_shapes(model, b, total))
         decode = _cache_decode_program(model, b, p, total, temperature,
                                        top_k, top_p)
-        return decode(params, cache, buf, rng)
-
-    decode = _full_decode_program(model, b, p, total, temperature,
-                                  top_k, top_p)
-    return decode(params, buf, rng)
+        args = (params, cache, buf, rng)
+    else:
+        decode = _full_decode_program(model, b, p, total, temperature,
+                                      top_k, top_p)
+        args = (params, buf, rng)
+    if ledger is None:
+        return decode(*args)
+    t0 = time.perf_counter()
+    out = decode(*args)
+    dispatch_s = time.perf_counter() - t0
+    jax.block_until_ready(out)
+    total_s = time.perf_counter() - t0
+    tokens = b * steps
+    ledger.emit("decode", tokens=tokens, seconds=round(total_s, 6),
+                throughput=round(tokens / max(total_s, 1e-9), 1),
+                dispatch_s=round(dispatch_s, 6),
+                device_s=round(total_s - dispatch_s, 6),
+                cached=use_cache, batch=b, prompt_len=p, steps=steps,
+                quant=quant)
+    return out
 
 
 def _refuse_wo_tree(effective_mode: str, params) -> None:

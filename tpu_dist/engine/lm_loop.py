@@ -20,7 +20,6 @@ Mode selection is by mesh axes, exactly like scripts/8:
 
 from __future__ import annotations
 
-import csv
 import os
 import time
 from functools import partial
@@ -42,6 +41,7 @@ from tpu_dist.engine.lm_steps import (LM_METRIC_KEYS, make_lm_batches,
                                       make_lm_sp_train_step,
                                       make_lm_train_step)
 from tpu_dist.engine.state import TrainState
+from tpu_dist.obs import RunObs, profile_session, step_annotation
 from tpu_dist.ops import lm_lr_schedule, make_optimizer, make_policy
 from tpu_dist.parallel.mesh import make_mesh, replicated
 from tpu_dist.utils.meters import MeterBank
@@ -324,10 +324,14 @@ class LMTrainer:
                      f"(epoch {self.start_epoch})")
         self.state = self._place(state)
         self._epoch_in_progress = self.start_epoch
-        self._flops_per_step = None  # lazily from XLA cost analysis
+        self._flops_per_step = None  # analytical, lazily (utils.mfu)
+        self._program_hbm = None     # post-dispatch probe (telemetry contract)
         self.last_tok_s = 0.0        # last epoch's train-phase tokens/sec
         self._warmed = False         # first dispatch carries XLA compile;
                                      # its wall time is excluded from tok/s
+        # run observability: ledger + tracer + skew monitor + hang watchdog
+        # (obs.RunObs) — the LM engine's step records carry tok/s + MFU
+        self.obs = RunObs("lm", cfg, self.mesh, unit="tok/s")
 
     # ------------------------------------------------------------------
     def _validate_mode(self):
@@ -494,11 +498,20 @@ class LMTrainer:
         return (np.asarray(idx[:n], np.int32).reshape(shape),
                 np.asarray(valid[:n], np.float32).reshape(shape))
 
-    @staticmethod
-    def _drain(pending, meters) -> None:
-        for m in jax.device_get(pending):
+    def _drain(self, pending, meters) -> None:
+        """One blocking transfer per print window (the async-dispatch sync
+        point), then one ledger ``step`` record per drained entry with the
+        transfer's device-block time apportioned across the window."""
+        with self.obs.tracer.span("device"):
+            fetched = jax.device_get([m for m, _ in pending])
+        device_s = self.obs.tracer.pop().get("device", 0.0)
+        total_steps = sum(info["n_steps"] for _, info in pending) or 1
+        from tpu_dist.utils.telemetry import device_memory_stats
+        hbm = device_memory_stats()
+        for m, (_, info) in zip(fetched, pending):
             cnt = float(m["count"])
-            meters.update("Loss", float(m["loss_sum"]) / cnt, int(cnt))
+            loss = float(m["loss_sum"]) / cnt
+            meters.update("Loss", loss, int(cnt))
             meters.update("Acc", float(m["correct1"]) / cnt, int(cnt))
             # MoE router health: mean per-token combine mass (1.0 = no
             # capacity drops; the dropped fraction is ~(1 - RMass) for
@@ -507,7 +520,18 @@ class LMTrainer:
             if n > 0:
                 meters.update("RMass", float(m["router_mass_sum"]) / n,
                               int(n))
+            share = device_s * info["n_steps"] / total_steps
+            self.obs.step(
+                info["step"], loss, info["n_items"],
+                wall_s=info["data_s"] + info["dispatch_s"] + share,
+                data_s=info["data_s"], dispatch_s=info["dispatch_s"],
+                device_s=share, device_flops=self._device_step_flops(),
+                steps_in_dispatch=info["n_steps"],
+                warm=info.get("warm", False),
+                hbm_bytes_in_use=hbm.get("bytes_in_use"),
+                hbm_peak_bytes=hbm.get("peak_bytes_in_use"))
         pending.clear()
+        self.obs.heartbeat()  # watchdog: device progress proven at this sync
 
     def _meter_fields(self):
         fields = [("Time", "6.3f"), ("Data", "6.3f"), ("Loss", ".4e"),
@@ -527,6 +551,7 @@ class LMTrainer:
                            prefix=f"Epoch: [{epoch}]")
         skip = self._skip_batches
         self._skip_batches = 0
+        self.obs.resume()  # watchdog watches from epoch entry
         if self.accum > 1:
             # host-side split into (N, B/N, L) microbatches, sharded
             # (None, 'data') so every microbatch spans all devices
@@ -552,28 +577,43 @@ class LMTrainer:
         pending = []
         warm_secs, warm_batches = 0.0, 0
         i = skip - 1
+        tokens_per_batch = cfg.batch_size * cfg.seq_len
+        tr = self.obs.tracer
         end = time.time()
         for i, inputs_d, targets_d in stream_prefetch(batches()):
-            meters.update("Data", time.time() - end)
-            self.state, metrics = self.train_step(
-                self.state, inputs_d, targets_d, self.rng)
+            data_s = time.time() - end
+            meters.update("Data", data_s)
+            gstep = epoch * self.steps_per_epoch + i
+            was_cold = not self._warmed  # this dispatch carries the compile
+            with step_annotation(gstep, self.obs.profiling), \
+                    tr.span("dispatch"):
+                self.state, metrics = self.train_step(
+                    self.state, inputs_d, targets_d, self.rng)
+            dispatch_s = tr.pop().get("dispatch", 0.0)
             if not self._warmed:
                 jax.device_get(metrics)  # compile + first step, to the wall
                 self._warmed = True
                 warm_secs = time.time() - end
                 warm_batches = 1
-            if getattr(self, "_program_hbm", None) is None:
+            if self._program_hbm is None:
                 # probe AFTER the dispatch (and after the warm-timing
                 # device_get, so warm_secs stays honest): the AOT lower/
                 # compile would not seed jit's dispatch cache, so probing
                 # first would compile the step twice (telemetry.py
                 # contract); same-iteration probing keeps the column on
                 # single-dispatch runs
-                from tpu_dist.utils.telemetry import program_hbm_bytes
-                self._program_hbm = program_hbm_bytes(
-                    self.train_step, self.state, inputs_d, targets_d,
-                    self.rng) or False  # False = probed, unavailable
-            pending.append(metrics)
+                from tpu_dist.utils.telemetry import program_stats
+                st = program_stats(self.train_step, self.state, inputs_d,
+                                   targets_d, self.rng)
+                self._program_hbm = st["hbm_bytes"] or False
+                self.obs.ledger.emit(
+                    "compile", program="train_step",
+                    seconds=warm_secs or None,
+                    hbm_bytes=st["hbm_bytes"], flops=st["flops"])
+            pending.append((metrics, {
+                "step": gstep, "n_steps": 1, "n_items": tokens_per_batch,
+                "data_s": data_s, "dispatch_s": dispatch_s,
+                "warm": was_cold}))
             boundary = i % cfg.print_freq == 0 or i == nb - 1
             if boundary:
                 self._drain(pending, meters)
@@ -585,12 +625,14 @@ class LMTrainer:
                 break
         if pending:  # a max_steps break can land between print boundaries
             self._drain(pending, meters)
+        self.obs.pause()  # eval/ckpt follow: steps stop completing by design
         done = i + 1 - skip if nb else 0
-        out = {"loss": meters.avg("Loss"), "acc": meters.avg("Acc"),
+        snap = meters.snapshot()  # ONE read feeds printer, ledger, and return
+        out = {"loss": snap["Loss"]["avg"], "acc": snap["Acc"]["avg"],
                "batches": done, "warmup_secs": warm_secs,
                "warmup_batches": warm_batches}
         if self.cfg.num_experts:
-            out["rmass"] = meters.avg("RMass")
+            out["rmass"] = snap["RMass"]["avg"]
         return out
 
     def _device_windows(self, epoch: int, skip: int, put):
@@ -617,6 +659,7 @@ class LMTrainer:
                            prefix=f"Epoch: [{epoch}]")
         skip = self._skip_batches
         self._skip_batches = 0
+        self.obs.resume()  # watchdog watches from epoch entry
         win_sh = NamedSharding(self.mesh, P(None, "data"))
         put = partial(assemble_global, win_sh)
         cached = self._prefetched_windows
@@ -629,25 +672,40 @@ class LMTrainer:
         done = skip
         last_print = skip - 1
         warm_secs, warm_batches = 0.0, 0
+        tokens_per_batch = cfg.batch_size * cfg.seq_len
+        tr = self.obs.tracer
         end = time.time()
         for n, idx_dev in windows:
-            meters.update("Data", (time.time() - end) / n, n)
-            self.state, metrics = self.window_step(
-                self.state, self._train_rows_dev, idx_dev, self.rng)
+            data_s = time.time() - end
+            meters.update("Data", data_s / n, n)
+            was_cold = not self._warmed  # this dispatch carries the compile
+            with step_annotation(epoch * self.steps_per_epoch + done,
+                                 self.obs.profiling), tr.span("dispatch"):
+                self.state, metrics = self.window_step(
+                    self.state, self._train_rows_dev, idx_dev, self.rng)
+            dispatch_s = tr.pop().get("dispatch", 0.0)
             if not self._warmed:
                 jax.device_get(metrics)  # compile + first window, to the wall
                 self._warmed = True
                 warm_secs = time.time() - end
                 warm_batches = n
-            if getattr(self, "_program_hbm", None) is None:
+            if self._program_hbm is None:
                 # post-dispatch probe (same iteration, so single-window
-                # runs record it too): see telemetry.program_hbm_bytes
-                from tpu_dist.utils.telemetry import program_hbm_bytes
-                self._program_hbm = program_hbm_bytes(
-                    self.window_step, self.state, self._train_rows_dev,
-                    idx_dev, self.rng) or False  # False = probed, unavailable
+                # runs record it too): see telemetry.program_stats
+                from tpu_dist.utils.telemetry import program_stats
+                st = program_stats(self.window_step, self.state,
+                                   self._train_rows_dev, idx_dev, self.rng)
+                self._program_hbm = st["hbm_bytes"] or False
+                self.obs.ledger.emit(
+                    "compile", program="window_step",
+                    seconds=warm_secs or None,
+                    hbm_bytes=st["hbm_bytes"], flops=st["flops"])
             done += n
-            pending.append(metrics)
+            pending.append((metrics, {
+                "step": epoch * self.steps_per_epoch + done - 1,
+                "n_steps": n, "n_items": n * tokens_per_batch,
+                "data_s": data_s, "dispatch_s": dispatch_s,
+                "warm": was_cold}))
             boundary = (done - 1) - last_print >= cfg.print_freq or done == nb
             if boundary and done == nb and epoch + 1 < cfg.epochs:
                 # queue next epoch's index uploads before blocking on metrics
@@ -664,11 +722,13 @@ class LMTrainer:
                 break
         if pending:  # a max_steps break can land between print boundaries
             self._drain(pending, meters)
-        out = {"loss": meters.avg("Loss"), "acc": meters.avg("Acc"),
+        self.obs.pause()  # eval/ckpt follow: steps stop completing by design
+        snap = meters.snapshot()
+        out = {"loss": snap["Loss"]["avg"], "acc": snap["Acc"]["avg"],
                "batches": done - skip, "warmup_secs": warm_secs,
                "warmup_batches": warm_batches}
         if self.cfg.num_experts:
-            out["rmass"] = meters.avg("RMass")
+            out["rmass"] = snap["RMass"]["avg"]
         return out
 
     def _step_cap_hit(self, epoch: int, batches_done: int) -> bool:
@@ -708,22 +768,20 @@ class LMTrainer:
         loss = sums["loss_sum"] / n
         ppl = float(np.exp(min(loss, 30.0)))
         acc = sums["correct1"] / n
+        self.obs.ledger.emit("eval", epoch=epoch, loss=loss, ppl=ppl,
+                             acc=acc, count=int(sums["count"]))
         self.log(f" * val_loss {loss:.4f} ppl {ppl:.2f} acc {acc:.3f}")
         return loss, ppl, acc
 
     # ------------------------------------------------------------------
-    def _mfu(self, tok_per_sec: float):
-        """(tflops, mfu). ANALYTICAL model-FLOPs accounting for dense
-        (6*N_non-embed + 6*layers*L*d, fwd+bwd, causal) AND MoE (dense part
-        + top_k-activated expert params + the GShard dispatch/combine
-        einsums) — XLA's cost model counts scan bodies once and cannot cost
-        Pallas custom calls, so it understates flash runs, and it cannot
-        see how many experts a token activates (VERDICT r3 #4)."""
-        from tpu_dist.utils.mfu import (lm_flops_per_token,
-                                        moe_lm_flops_per_token,
-                                        peak_tflops_for)
+    def _device_step_flops(self):
+        """Per-device-program share of ONE optimizer step's model FLOPs
+        (analytical — utils.mfu; computed once, lazily). Feeds both the
+        epoch-line MFU (:meth:`_mfu`) and the per-step ledger records."""
         cfg = self.cfg
         if self._flops_per_step is None:
+            from tpu_dist.utils.mfu import (lm_flops_per_token,
+                                            moe_lm_flops_per_token)
             if cfg.num_experts:
                 per_token = moe_lm_flops_per_token(
                     self.state.params, cfg.num_layers, cfg.seq_len,
@@ -736,10 +794,19 @@ class LMTrainer:
                     self.state.params, cfg.num_layers, cfg.seq_len,
                     cfg.d_model)
             ndev = self.mesh.devices.size
-            # stored as the per-device-program share of one step's FLOPs
             self._flops_per_step = per_token * cfg.batch_size * \
                 cfg.seq_len / ndev
-        if not self._flops_per_step:
+        return self._flops_per_step or None
+
+    def _mfu(self, tok_per_sec: float):
+        """(tflops, mfu). ANALYTICAL model-FLOPs accounting for dense
+        (6*N_non-embed + 6*layers*L*d, fwd+bwd, causal) AND MoE (dense part
+        + top_k-activated expert params + the GShard dispatch/combine
+        einsums) — XLA's cost model counts scan bodies once and cannot cost
+        Pallas custom calls, so it understates flash runs, and it cannot
+        see how many experts a token activates (VERDICT r3 #4)."""
+        from tpu_dist.utils.mfu import peak_tflops_for
+        if not self._device_step_flops():
             return None, None
         # per-device program FLOPs over the tokens IT processes per step
         tokens_per_step = self.cfg.batch_size * self.cfg.seq_len
@@ -753,21 +820,29 @@ class LMTrainer:
     def fit(self) -> float:
         """Returns best val perplexity."""
         cfg = self.cfg
+        self.obs.run_start()
         if cfg.evaluate:
-            return self.validate(0)[1]
-        profiling = bool(cfg.profile_dir) and self.is_main
-        if profiling:
-            # real XLA trace (per-op device time, HBM, MXU utilization) —
-            # the same C22 telemetry hook the image Trainer has
-            import jax.profiler
-            jax.profiler.start_trace(cfg.profile_dir)
+            try:
+                return self.validate(0)[1]
+            finally:
+                self.obs.run_end(best_ppl=self.best_ppl)
         stop_telemetry = None
-        if cfg.telemetry_csv and self.is_main:
+        if cfg.telemetry_csv:
+            # EVERY process samples; non-main paths are .pN-suffixed so
+            # multi-host runs never clobber one file (obs.per_process_path)
+            from tpu_dist.obs import per_process_path
             from tpu_dist.utils.telemetry import start_hbm_sampler
-            stop_telemetry = start_hbm_sampler(cfg.telemetry_csv)
+            stop_telemetry = start_hbm_sampler(
+                per_process_path(cfg.telemetry_csv, jax.process_index()),
+                ledger=self.obs.ledger)
         try:
-            self._fit_epochs()
+            # real XLA trace (per-op device time, HBM, MXU utilization) —
+            # the same C22 hook the image Trainer has; obs.profile_session
+            # flushes it even on OOM/interrupt
+            with profile_session(cfg.profile_dir, self.obs.profiling):
+                self._fit_epochs()
         except KeyboardInterrupt:
+            self.obs.pause()  # slow interrupt-save is not a stall
             if cfg.checkpoint_dir:
                 ckpt.save_checkpoint(cfg.checkpoint_dir, self.state,
                                      self._epoch_in_progress,
@@ -784,11 +859,7 @@ class LMTrainer:
             if stop_telemetry is not None:
                 stop_telemetry()
             ckpt.wait_for_async_save()
-            if profiling:
-                # flush the trace even on OOM/interrupt — a failing run is
-                # exactly the one worth profiling
-                import jax.profiler
-                jax.profiler.stop_trace()
+            self.obs.run_end(best_ppl=self.best_ppl)
         return self.best_ppl
 
     def _fit_epochs(self) -> None:
@@ -816,19 +887,24 @@ class LMTrainer:
             tflops, mfu = self._mfu(tok_s)
             is_best = ppl < self.best_ppl
             self.best_ppl = min(ppl, self.best_ppl)
-            if cfg.log_csv and self.is_main:
-                from tpu_dist.utils.telemetry import peak_hbm_bytes
-                with open(cfg.log_csv, "a+", newline="") as f:
-                    csv.writer(f).writerow(
-                        [t0, epoch_secs, round(tok_s, 1),
-                         peak_hbm_bytes()
-                         or getattr(self, "_program_hbm", None) or ""])
+            # the epoch record; the legacy per-epoch CSV row renders from
+            # THIS event via the obs layer's EpochCsvSink — one source
+            from tpu_dist.utils.telemetry import peak_hbm_bytes
+            self.obs.ledger.emit(
+                "epoch", epoch=epoch, start_ts=t0, seconds=epoch_secs,
+                throughput=tok_s, unit="tok/s",
+                loss=train_metrics["loss"], ppl=ppl, mfu=mfu, tflops=tflops,
+                hbm_bytes=peak_hbm_bytes() or self._program_hbm or None,
+                batches=train_metrics.get("batches"))
             if cfg.checkpoint_dir:
                 ckpt.save_checkpoint(
                     cfg.checkpoint_dir, self.state, epoch + 1, 0.0, "lm",
                     is_best, extra_meta={"best_ppl": self.best_ppl,
                                          **self._run_meta},
                     async_write=True)
+                self.obs.ledger.emit(
+                    "ckpt", epoch=epoch + 1, path=cfg.checkpoint_dir,
+                    is_best=is_best)
             # LR actually applied by the LAST update of this epoch (the
             # schedule is evaluated at the pre-increment step counter)
             lr_now = float(np.asarray(self.lr_schedule(

@@ -16,7 +16,6 @@ design:
 
 from __future__ import annotations
 
-import csv
 import os
 import time
 from functools import partial
@@ -36,6 +35,7 @@ from tpu_dist.engine.steps import (make_eval_step, make_indexed_multi_train_step
                                    make_multi_train_step,
                                    make_shard_map_train_step, make_train_step)
 from tpu_dist.models import create_model
+from tpu_dist.obs import RunObs, profile_session, step_annotation
 from tpu_dist.ops import LossScaleState, make_optimizer, make_policy, step_decay_schedule
 from tpu_dist.parallel.mesh import batch_sharding, make_mesh, replicated
 from tpu_dist.utils.meters import MeterBank
@@ -351,6 +351,11 @@ class Trainer:
                              f"{self.start_epoch}, skipping "
                              f"{self._skip_batches} already-applied batches")
         self._epoch_in_progress = self.start_epoch
+        self._program_hbm = None    # post-dispatch probe (telemetry contract)
+        self._program_flops = None  # per-device step FLOPs (XLA cost model)
+        # run observability: ledger + step tracer + skew monitor + hang
+        # watchdog, wired from cfg (obs.RunObs); a pathless ledger is free
+        self.obs = RunObs("image", cfg, self.mesh, unit="img/s")
 
     # ------------------------------------------------------------------
     def log(self, *a, **k):
@@ -371,16 +376,37 @@ class Trainer:
         return DataLoader(ds, self._sampler(ds, train, epoch), self.local_batch,
                           workers=self.cfg.workers, emit_valid=not train)
 
-    @staticmethod
-    def _drain(pending, meters) -> None:
+    def _drain(self, pending, meters) -> None:
         """Pull queued device metric sums into the meter bank (ONE blocking
-        transfer per print window — the async-dispatch sync point)."""
-        for m in jax.device_get(pending):
+        transfer per print window — the async-dispatch sync point) and emit
+        one ledger ``step`` record per drained entry: the device-block time
+        of the transfer is apportioned across the window's steps, so every
+        record carries the full data/dispatch/device phase breakdown."""
+        with self.obs.tracer.span("device"):
+            fetched = jax.device_get([m for m, _ in pending])
+        device_s = self.obs.tracer.pop().get("device", 0.0)
+        total_steps = sum(info["n_steps"] for _, info in pending) or 1
+        from tpu_dist.utils.telemetry import device_memory_stats
+        hbm = device_memory_stats()
+        for m, (_, info) in zip(fetched, pending):
             cnt = float(m["count"])
-            meters.update("Loss", float(m["loss_sum"]) / cnt, int(cnt))
-            meters.update("Acc@1", float(m["correct1"]) / cnt, int(cnt))
+            loss = float(m["loss_sum"]) / cnt
+            acc1 = float(m["correct1"]) / cnt
+            meters.update("Loss", loss, int(cnt))
+            meters.update("Acc@1", acc1, int(cnt))
             meters.update("Acc@5", float(m["correct5"]) / cnt, int(cnt))
+            share = device_s * info["n_steps"] / total_steps
+            self.obs.step(
+                info["step"], loss, info["n_items"],
+                wall_s=info["data_s"] + info["dispatch_s"] + share,
+                data_s=info["data_s"], dispatch_s=info["dispatch_s"],
+                device_s=share, device_flops=self._program_flops,
+                steps_in_dispatch=info["n_steps"],
+                warm=info.get("warm", False), acc1=acc1,
+                hbm_bytes_in_use=hbm.get("bytes_in_use"),
+                hbm_peak_bytes=hbm.get("peak_bytes_in_use"))
         pending.clear()
+        self.obs.heartbeat()  # watchdog: device progress proven at this sync
 
     # ------------------------------------------------------------------
     def train_epoch(self, epoch: int) -> Dict[str, float]:
@@ -395,6 +421,7 @@ class Trainer:
                            prefix=f"Epoch: [{epoch}]")
         skip = self._skip_batches
         self._skip_batches = 0
+        self.obs.resume()  # watchdog watches from epoch entry
         pending = []
         end = time.time()
         if self.accum > 1:
@@ -411,26 +438,40 @@ class Trainer:
             it = prefetch_to_device(map(split, iter(loader)), micro_sh)
         else:
             it = prefetch_to_device(iter(loader), self.batch_sharding)
+        tr = self.obs.tracer
         for i, (images, labels) in enumerate(it):
             if i < skip:  # step-exact resume of a mid-epoch checkpoint
                 end = time.time()
                 continue
-            meters.update("Data", time.time() - end)
-            self.state, metrics = self.train_step(
-                self.state, images, labels, self.rng)
-            if getattr(self, "_program_hbm", None) is None:
-                # static per-program peak (CSV column; lower() is abstract,
-                # so donation is untouched). Probed AFTER the dispatch just
-                # above: the AOT compile would not seed jit's dispatch
-                # cache, so probing first would compile the step twice
-                # (utils.telemetry.program_hbm_bytes contract) — and
-                # probing post-dispatch in the SAME iteration means even a
-                # single-dispatch run still records the column
-                from tpu_dist.utils.telemetry import program_hbm_bytes
-                self._program_hbm = program_hbm_bytes(
-                    self.train_step, self.state, images, labels,
-                    self.rng) or False  # False = probed, unavailable
-            pending.append(metrics)
+            data_s = time.time() - end
+            meters.update("Data", data_s)
+            gstep = epoch * self.steps_per_epoch + i
+            was_cold = self._program_hbm is None  # this dispatch compiles
+            with step_annotation(gstep, self.obs.profiling), \
+                    tr.span("dispatch"):
+                self.state, metrics = self.train_step(
+                    self.state, images, labels, self.rng)
+            dispatch_s = tr.pop().get("dispatch", 0.0)
+            if self._program_hbm is None:
+                # static per-program peak + step FLOPs (CSV column / MFU;
+                # lower() is abstract, so donation is untouched). Probed
+                # AFTER the dispatch just above: the AOT compile would not
+                # seed jit's dispatch cache, so probing first would compile
+                # the step twice (utils.telemetry.program_stats contract) —
+                # and probing post-dispatch in the SAME iteration means
+                # even a single-dispatch run still records the column
+                from tpu_dist.utils.telemetry import program_stats
+                st = program_stats(self.train_step, self.state, images,
+                                   labels, self.rng)
+                self._program_hbm = st["hbm_bytes"] or False
+                self._program_flops = st["flops"]
+                self.obs.ledger.emit("compile", program="train_step",
+                                     hbm_bytes=st["hbm_bytes"],
+                                     flops=st["flops"])
+            pending.append((metrics, {
+                "step": gstep, "n_steps": 1, "n_items": cfg.batch_size,
+                "data_s": data_s, "dispatch_s": dispatch_s,
+                "warm": was_cold}))
             boundary = i % cfg.print_freq == 0 or i == nb - 1
             if boundary:
                 self._drain(pending, meters)
@@ -441,8 +482,10 @@ class Trainer:
             if boundary and self.is_main:
                 meters.display(i)
             end = time.time()
-        return {"loss": meters.avg("Loss"), "top1": meters.avg("Acc@1"),
-                "top5": meters.avg("Acc@5"), "batches": nb - skip}
+        self.obs.pause()  # eval/ckpt follow: step completions stop by design
+        snap = meters.snapshot()  # ONE read feeds printer, ledger, and return
+        return {"loss": snap["Loss"]["avg"], "top1": snap["Acc@1"]["avg"],
+                "top5": snap["Acc@5"]["avg"], "batches": nb - skip}
 
     def _host_windows(self, loader, skip: int):
         """Yield (n_batches, (imgs (K,B,...), lbls (K,B))) host-stacked
@@ -511,6 +554,7 @@ class Trainer:
                            prefix=f"Epoch: [{epoch}]")
         skip = self._skip_batches
         self._skip_batches = 0
+        self.obs.resume()  # watchdog watches from epoch entry
         win_sh = NamedSharding(self.mesh, P(None, "data"))
         put = partial(assemble_global, win_sh)
         if self.device_data:
@@ -534,24 +578,39 @@ class Trainer:
         pending = []  # window metric sums awaiting the next print boundary
         done = skip
         last_print = skip - 1
+        tr = self.obs.tracer
         end = time.time()
         for n, dev_payload in windows:
             # per-BATCH seconds (window seconds / n, weighted n) so the
             # printed avg keeps the per-batch path's meaning:
             # avg(Time) = wall / batches in both paths
-            meters.update("Data", (time.time() - end) / n, n)
-            self.state, metrics = dispatch(self.state, dev_payload)
-            if getattr(self, "_program_hbm", None) is None:
+            data_s = time.time() - end
+            meters.update("Data", data_s / n, n)
+            was_cold = self._program_hbm is None  # this dispatch compiles
+            with step_annotation(epoch * self.steps_per_epoch + done,
+                                 self.obs.profiling), tr.span("dispatch"):
+                self.state, metrics = dispatch(self.state, dev_payload)
+            dispatch_s = tr.pop().get("dispatch", 0.0)
+            if self._program_hbm is None:
                 # post-dispatch probe (same iteration, so single-window
-                # runs record it too): see telemetry.program_hbm_bytes
-                from tpu_dist.utils.telemetry import program_hbm_bytes
+                # runs record it too): see telemetry.program_stats; the
+                # cost model counts the scan body once, so flops ~= ONE
+                # optimizer step of the window program
+                from tpu_dist.utils.telemetry import program_stats
                 args = ((*self._train_data_dev, dev_payload, self.rng)
                         if self.device_data else (*dev_payload, self.rng))
-                self._program_hbm = program_hbm_bytes(
-                    self.window_step, self.state,
-                    *args) or False  # False = probed, unavailable
+                st = program_stats(self.window_step, self.state, *args)
+                self._program_hbm = st["hbm_bytes"] or False
+                self._program_flops = st["flops"]
+                self.obs.ledger.emit("compile", program="window_step",
+                                     hbm_bytes=st["hbm_bytes"],
+                                     flops=st["flops"])
             done += n
-            pending.append(metrics)
+            pending.append((metrics, {
+                "step": epoch * self.steps_per_epoch + done - 1,
+                "n_steps": n, "n_items": n * cfg.batch_size,
+                "data_s": data_s, "dispatch_s": dispatch_s,
+                "warm": was_cold}))
             boundary = (done - 1) - last_print >= cfg.print_freq or done == nb
             if boundary and done == nb and self.device_data \
                     and epoch + 1 < cfg.epochs:
@@ -566,8 +625,10 @@ class Trainer:
             if boundary and self.is_main:
                 meters.display(done - 1)
             end = time.time()
-        return {"loss": meters.avg("Loss"), "top1": meters.avg("Acc@1"),
-                "top5": meters.avg("Acc@5"), "batches": nb - skip}
+        self.obs.pause()  # eval/ckpt follow: step completions stop by design
+        snap = meters.snapshot()
+        return {"loss": snap["Loss"]["avg"], "top1": snap["Acc@1"]["avg"],
+                "top5": snap["Acc@5"]["avg"], "batches": nb - skip}
 
     def validate(self, epoch: int = 0) -> float:
         """Distributed eval (C15): metric sums psum'd across replicas, padding
@@ -601,6 +662,8 @@ class Trainer:
         n = max(sums["count"], 1.0)
         acc1 = sums["correct1"] / n
         acc5 = sums["correct5"] / n
+        self.obs.ledger.emit("eval", epoch=epoch, loss=sums["loss_sum"] / n,
+                             acc1=acc1, acc5=acc5, count=int(sums["count"]))
         self.log(f" * Acc@1 {acc1 * 100:.3f} Acc@5 {acc5 * 100:.3f} "
                  f"Loss {sums['loss_sum'] / n:.4f}")
         return acc1
@@ -608,23 +671,32 @@ class Trainer:
     # ------------------------------------------------------------------
     def fit(self) -> float:
         cfg = self.cfg
+        self.obs.run_start()
         if cfg.evaluate:
-            return self.validate()
-        profiling = bool(cfg.profile_dir) and self.is_main
-        if profiling:
-            # device tracing (reference's only profiling was wall-clock CSVs +
-            # nvidia-smi sampling, statistics.sh:1-4; the TPU-native answer is
-            # a real XLA trace: per-op device time, HBM, MXU utilization)
-            import jax.profiler
-            jax.profiler.start_trace(cfg.profile_dir)
-        csv_path = cfg.log_csv or ""
+            try:
+                return self.validate()
+            finally:
+                self.obs.run_end(best_acc1=self.best_acc1)
         stop_telemetry = None
-        if cfg.telemetry_csv and self.is_main:
+        if cfg.telemetry_csv:
+            # EVERY process samples (multi-host skew forensics need the
+            # straggler's memory timeline too); non-main paths are
+            # .pN-suffixed so files never clobber (obs.per_process_path)
+            from tpu_dist.obs import per_process_path
             from tpu_dist.utils.telemetry import start_hbm_sampler
-            stop_telemetry = start_hbm_sampler(cfg.telemetry_csv)
+            stop_telemetry = start_hbm_sampler(
+                per_process_path(cfg.telemetry_csv, jax.process_index()),
+                ledger=self.obs.ledger)
         try:
-            self._fit_epochs(csv_path)
+            # device tracing (reference's only profiling was wall-clock CSVs
+            # + nvidia-smi sampling, statistics.sh:1-4; the TPU-native answer
+            # is a real XLA trace — obs.profile_session flushes it even on
+            # OOM/interrupt: a failing run is exactly the one worth
+            # profiling)
+            with profile_session(cfg.profile_dir, self.obs.profiling):
+                self._fit_epochs()
         except KeyboardInterrupt:
+            self.obs.pause()  # slow interrupt-save is not a stall
             # strictly better than the reference (no try/except around its
             # training at all, SURVEY.md §5 'Failure detection'): an interrupt
             # leaves a resumable checkpoint instead of losing the run
@@ -640,14 +712,10 @@ class Trainer:
             if stop_telemetry is not None:
                 stop_telemetry()
             ckpt.wait_for_async_save()  # never exit with a write in flight
-            if profiling:
-                # flush the trace even on OOM/interrupt — a failing run is
-                # exactly the one worth profiling
-                import jax.profiler
-                jax.profiler.stop_trace()
+            self.obs.run_end(best_acc1=self.best_acc1)
         return self.best_acc1
 
-    def _fit_epochs(self, csv_path: str) -> None:
+    def _fit_epochs(self) -> None:
         cfg = self.cfg
         for epoch in range(self.start_epoch, cfg.epochs):
             self._epoch_in_progress = epoch
@@ -665,24 +733,27 @@ class Trainer:
             train_ips = train_imgs / max(train_secs, 1e-9)
             is_best = acc1 > self.best_acc1
             self.best_acc1 = max(acc1, self.best_acc1)
-            if csv_path and self.is_main:
-                # reference CSV format [wall start, epoch seconds] + tpu_dist
-                # extensions: train-phase images/sec and the allocator's
-                # peak-HBM high-water mark (VERDICT r4 #5; empty on backends
-                # without memory counters)
-                from tpu_dist.utils.telemetry import peak_hbm_bytes
-                with open(csv_path, "a+", newline="") as f:
-                    csv.writer(f).writerow(
-                        [t0, epoch_secs, round(train_ips, 1),
-                         # allocator truth when the backend exposes it,
-                         # else XLA's static per-program analysis
-                         peak_hbm_bytes()
-                         or getattr(self, "_program_hbm", None) or ""])
+            # the epoch record; the legacy CSV row (reference format
+            # [wall start, epoch seconds] + train-img/s and peak-HBM
+            # columns, VERDICT r4 #5) renders from THIS event via the
+            # EpochCsvSink the obs layer registered — one source of truth.
+            # hbm: allocator truth when the backend exposes it, else XLA's
+            # static per-program analysis (empty when neither exists)
+            from tpu_dist.utils.telemetry import peak_hbm_bytes
+            self.obs.ledger.emit(
+                "epoch", epoch=epoch, start_ts=t0, seconds=epoch_secs,
+                throughput=train_ips, unit="img/s",
+                loss=train_metrics["loss"], acc1=acc1,
+                hbm_bytes=peak_hbm_bytes() or self._program_hbm or None,
+                batches=train_metrics.get("batches"))
             # async: serialization + disk write overlap the next epoch (the
             # device->host gather stays on the critical path by necessity)
             ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, epoch + 1,
                                  self.best_acc1, cfg.arch, is_best,
                                  extra_meta=self._run_meta, async_write=True)
+            self.obs.ledger.emit(
+                "ckpt", epoch=epoch + 1, path=cfg.checkpoint_dir,
+                is_best=is_best)
             self.log(f"Epoch {epoch}: train_loss={train_metrics['loss']:.4f} "
                      f"val_acc1={acc1 * 100:.3f} best={self.best_acc1 * 100:.3f} "
                      f"({epoch_secs:.1f}s, train {train_ips:,.0f} img/s)")

@@ -1,0 +1,180 @@
+"""tpu_dist.obs — one observability subsystem for every run.
+
+Four pieces, one handle:
+
+* :mod:`~tpu_dist.obs.ledger` — append-only JSONL of typed events (the
+  source of truth; the epoch CSV and progress line render FROM it);
+* :mod:`~tpu_dist.obs.trace` — host-side step-phase spans that also emit
+  ``jax.profiler`` annotations when a trace is active;
+* :mod:`~tpu_dist.obs.skew` — cross-host step-time allgather every K steps
+  (straggler index, p50/p99/spread);
+* :mod:`~tpu_dist.obs.watchdog` — trailing-median hang detector that dumps
+  thread stacks + HBM to stderr and the ledger, once per stall.
+
+:class:`RunObs` wires them from a config (``ledger_path`` /
+``watchdog_factor`` / ``skew_every`` / ``log_csv`` / ``profile_dir``) so the
+image Trainer, the LMTrainer, ``engine.generate`` and ``bench.py`` all feed
+the SAME records instead of five bespoke logging stacks. MFU per step is
+computed here against the device's bf16 peak; on backends with no published
+peak (CPU, virtual) the field stays non-null by normalizing against a
+nominal ``TPU_DIST_NOMINAL_PEAK_TFLOPS`` (default 1.0 — i.e. the value
+reads as model TFLOP/s) and ``run_start`` carries ``peak_is_nominal`` so
+readers can tell the two apart.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional
+
+from tpu_dist.obs.ledger import (EVENT_SCHEMA, EpochCsvSink, Ledger,
+                                 ProgressSink, per_process_path, phase_totals,
+                                 read_ledger)
+from tpu_dist.obs.skew import SkewMonitor
+from tpu_dist.obs.trace import StepTracer, profile_session, step_annotation
+from tpu_dist.obs.watchdog import Watchdog
+
+__all__ = ["EVENT_SCHEMA", "EpochCsvSink", "Ledger", "ProgressSink",
+           "RunObs", "SkewMonitor", "StepTracer", "Watchdog",
+           "per_process_path", "phase_totals", "profile_session",
+           "read_ledger", "step_annotation"]
+
+
+def effective_peak_tflops() -> tuple:
+    """(peak_tflops, is_nominal): the device's published bf16 peak, or the
+    nominal fallback that keeps per-step MFU non-null on CPU/virtual
+    backends (MFU then reads as model TFLOP/s per chip)."""
+    import jax
+    from tpu_dist.utils.mfu import peak_tflops_for
+
+    peak = peak_tflops_for(jax.devices()[0])
+    if peak:
+        return float(peak), False
+    return float(os.environ.get("TPU_DIST_NOMINAL_PEAK_TFLOPS", "1.0")), True
+
+
+class RunObs:
+    """Per-run observability handle: ledger + tracer + skew + watchdog.
+
+    Built unconditionally by both engines (a pathless ledger costs nothing),
+    so call sites never guard on "is observability on". ``unit`` names the
+    throughput unit of this run's step records ("img/s" | "tok/s").
+    """
+
+    def __init__(self, kind: str, cfg, mesh=None, unit: str = "items/s"):
+        import jax
+
+        self.kind = kind
+        self.cfg = cfg
+        self.unit = unit
+        pidx = jax.process_index()
+        self.is_main = pidx == 0
+        ledger_path = per_process_path(
+            getattr(cfg, "ledger_path", "") or "", pidx)
+        self.ledger = Ledger(ledger_path or None, process_index=pidx)
+        if getattr(cfg, "log_csv", "") and self.is_main:
+            # the legacy per-epoch CSV becomes a VIEW of the epoch event
+            self.ledger.add_sink(EpochCsvSink(cfg.log_csv))
+        profile_dir = getattr(cfg, "profile_dir", "") or ""
+        self.profiling = bool(profile_dir) and self.is_main
+        self.profile_dir = profile_dir
+        self.tracer = StepTracer(annotate=self.profiling)
+        skew_every = getattr(cfg, "skew_every", 0) or 0
+        self.skew = (SkewMonitor(skew_every, ledger=self.ledger)
+                     if skew_every > 0 else None)
+        wd_factor = getattr(cfg, "watchdog_factor", 0.0) or 0.0
+        self.watchdog = (Watchdog(wd_factor, ledger=self.ledger)
+                         if wd_factor > 0 else None)
+        self.peak_tflops, self.peak_is_nominal = effective_peak_tflops()
+        self._mesh_info = (
+            {name: int(size) for name, size in mesh.shape.items()}
+            if mesh is not None else None)
+        self._t0 = time.time()
+        self.steps = 0
+
+    # -- lifecycle ------------------------------------------------------
+    def run_start(self) -> None:
+        import jax
+
+        self._t0 = time.time()
+        self.ledger.emit(
+            "run_start", kind=self.kind,
+            config=dataclasses.asdict(self.cfg)
+            if dataclasses.is_dataclass(self.cfg) else dict(self.cfg),
+            mesh=self._mesh_info,
+            devices=sorted({d.device_kind for d in jax.local_devices()}),
+            process_count=jax.process_count(),
+            device_count=jax.device_count(),
+            peak_tflops=self.peak_tflops,
+            peak_is_nominal=self.peak_is_nominal)
+
+    def run_end(self, **extra) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self.ledger.emit("run_end", steps=self.steps,
+                         seconds=round(time.time() - self._t0, 3), **extra)
+        self.ledger.close()
+
+    # -- per-step -------------------------------------------------------
+    def step(self, step: int, loss: Optional[float], n_items: float,
+             wall_s: float, data_s: float, dispatch_s: float,
+             device_s: float, device_flops: Optional[float] = None,
+             steps_in_dispatch: int = 1, warm: bool = False,
+             **extra) -> dict:
+        """Record one optimizer step (or one K-step dispatch window).
+
+        ``n_items`` is the GLOBAL item count of the record (images or
+        tokens across all steps in the dispatch); ``device_flops`` is the
+        per-device model FLOPs of ONE optimizer step, from which TFLOP/s
+        and MFU derive. ``warm=True`` marks the record that carried the
+        XLA compile (its dispatch_s is compile-dominated; ledger_report
+        excludes warm records from phase shares and trends, matching the
+        loops' own warm-excluded throughput convention). Also feeds the
+        skew monitor. The hang watchdog is NOT fed here — step records
+        land only at drain boundaries, while the watchdog needs the
+        per-iteration cadence (:meth:`heartbeat`); feeding it boundary-
+        clustered single-step durations would false-fire on any run whose
+        print window exceeds factor x one step.
+        """
+        wall = max(wall_s, 1e-9)
+        throughput = n_items / wall
+        tflops = mfu = None
+        if device_flops:
+            tflops = device_flops * steps_in_dispatch / wall / 1e12
+            mfu = tflops / self.peak_tflops
+        rec = self.ledger.emit(
+            "step", step=step, loss=loss,
+            throughput=round(throughput, 1), unit=self.unit,
+            data_s=round(data_s, 6), dispatch_s=round(dispatch_s, 6),
+            device_s=round(device_s, 6),
+            mfu=float(f"{mfu:.4g}") if mfu is not None else None,
+            tflops=float(f"{tflops:.4g}") if tflops is not None else None,
+            steps_in_dispatch=steps_in_dispatch, warm=warm, **extra)
+        self.steps += steps_in_dispatch
+        if self.skew is not None:
+            self.skew.record(step, wall_s, data_s,
+                             n_steps=steps_in_dispatch)
+        return rec
+
+    def heartbeat(self) -> None:
+        """Device progress proven (a drain's blocking device_get returned)
+        — the watchdog's arming signal. The loops call this at every drain
+        sync point; the watchdog derives the duration itself (time since
+        the previous beat), so its trailing median tracks the print-window
+        cadence being watched — off-boundary iterations only ENQUEUE work
+        and prove nothing about the devices (Watchdog.beat)."""
+        if self.watchdog is not None:
+            self.watchdog.beat()
+
+    # -- phase transitions ---------------------------------------------
+    def pause(self) -> None:
+        """Entering a phase where step completions legitimately stop
+        (validation, checkpoint gather) — silence the watchdog."""
+        if self.watchdog is not None:
+            self.watchdog.pause()
+
+    def resume(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.resume()

@@ -1,0 +1,257 @@
+"""Run ledger: append-only JSONL of typed run events (the observability spine).
+
+The reference cookbook's only record of a run is whatever scrolled past on
+stdout plus a per-epoch CSV clone in every script; tpu_dist's round-1-5
+engines reproduced those and then grew ad-hoc extras (bench JSON, MFU
+prints, HBM probes) with no machine-readable per-step record. The ledger
+replaces all of that as the source of truth: every engine/bench/decode run
+appends one JSON object per event to ``ledger_path``, and the legacy
+artifacts (epoch CSV, progress line) become *sinks* rendered from ledger
+records rather than independently computed values.
+
+Schema discipline: ``EVENT_SCHEMA`` below is a PURE LITERAL (dict of
+event-name -> tuple of required field names) so ``tools/check_ledger_schema``
+can extract it by AST walk — without importing jax — and statically verify
+every ``*.emit("<event>", ...)`` call site in the tree names a declared
+event and passes its required fields. Values may be ``None`` (e.g. MFU on a
+backend with no cost model); *presence* is what the schema pins, so readers
+can always key into a record without guards.
+
+Multi-host: each process writes its OWN file — ``per_process_path`` suffixes
+non-main paths with the process index (``run.jsonl`` -> ``run.p1.jsonl``) so
+N processes never interleave writes into one file. ``emit`` is
+thread-safe (the HBM sampler and the hang watchdog feed the ledger from
+daemon threads).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import math
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+# event name -> required fields. PURE LITERAL (tools/check_ledger_schema
+# extracts it via ast.literal_eval — no computed values, no imports).
+# Required means "key present"; None values are legal where a backend
+# cannot supply the number. ``event``/``ts``/``pid`` are stamped by emit().
+EVENT_SCHEMA = {
+    # run identity: full config + mesh + device kinds, once per run
+    "run_start": ("kind", "config", "mesh", "devices", "process_count"),
+    # first-dispatch / AOT-probe record (program stats, warm seconds)
+    "compile": ("program",),
+    # one optimizer step (or one K-step dispatch window: steps_in_dispatch
+    # carries the window size) with the full phase breakdown
+    "step": ("step", "loss", "throughput", "unit",
+             "data_s", "dispatch_s", "device_s", "mfu"),
+    # end-of-epoch rollup (the legacy per-epoch CSV row renders from this)
+    "epoch": ("epoch", "start_ts", "seconds", "throughput", "unit", "loss"),
+    # held-out evaluation
+    "eval": ("epoch", "loss"),
+    # checkpoint written
+    "ckpt": ("epoch", "path", "is_best"),
+    # cross-host step-time skew sample (obs.skew every K steps)
+    "skew": ("step", "p50_s", "p99_s", "spread_s", "straggler"),
+    # hang-watchdog stall dump (obs.watchdog; once per stall)
+    "stall": ("idle_s", "threshold_s", "stacks"),
+    # periodic HBM sampler row (utils.telemetry feeding the ledger)
+    "hbm": ("bytes_in_use",),
+    # one generate() call (engine.generate with a ledger passed in)
+    "decode": ("tokens", "seconds", "throughput"),
+    # run rollup: total steps, wall seconds, best metric in extras
+    "run_end": ("steps", "seconds"),
+}
+
+
+def _json_safe(v):
+    """Non-finite floats (inf/nan — e.g. best_ppl before any eval) become
+    None: json.dumps would otherwise emit the bare tokens Infinity/NaN,
+    which are NOT valid JSON and break strict parsers (jq, pandas) on the
+    whole line — the machine-readability the ledger exists for."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    return v
+
+
+def per_process_path(path: str, process_index: int) -> str:
+    """Suffix non-main output paths with the process index so multi-host
+    runs never clobber one file: ``run.jsonl`` -> ``run.p1.jsonl`` for
+    process 1; process 0 keeps the bare path (single-host unchanged)."""
+    if not path or process_index == 0:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.p{process_index}{ext}"
+
+
+class Ledger:
+    """Append-only JSONL event log with schema validation and sinks.
+
+    ``path=None`` builds a sink-only ledger (no file): the engines always
+    carry one so the epoch-CSV sink, watchdog, and skew monitor have a
+    single emit() surface whether or not ``ledger_path`` is set.
+
+    Sinks are callables ``sink(record: dict)`` invoked on every emit —
+    the legacy renderers (epoch CSV, progress stdout) hang off here, so
+    they can never drift from the recorded values.
+    """
+
+    def __init__(self, path: Optional[str] = None, process_index: int = 0,
+                 sinks: tuple = ()):
+        self.path = path or None
+        self.process_index = process_index
+        self._f = open(path, "a", buffering=1) if path else None
+        self._lock = threading.Lock()
+        self._sinks: List[Callable[[dict], None]] = list(sinks)
+        self.last: Optional[dict] = None  # most recent record (watchdog dump)
+
+    def add_sink(self, sink: Callable[[dict], None]) -> None:
+        self._sinks.append(sink)
+
+    def emit(self, event: str, **fields) -> dict:
+        """Validate + append one typed record; returns the full record."""
+        required = EVENT_SCHEMA.get(event)
+        if required is None:
+            raise ValueError(f"undeclared ledger event {event!r} "
+                             f"(EVENT_SCHEMA: {sorted(EVENT_SCHEMA)})")
+        missing = [k for k in required if k not in fields]
+        if missing:
+            raise ValueError(f"ledger event {event!r} missing required "
+                             f"fields {missing}")
+        rec = _json_safe({"event": event, "ts": time.time(),
+                          "pid": self.process_index, **fields})
+        with self._lock:
+            self.last = rec
+            if self._f is not None and not self._f.closed:
+                # default=str: config dicts can carry tuples/dtypes — a
+                # ledger write must never take the run down
+                self._f.write(json.dumps(rec, default=str) + "\n")
+            for sink in self._sinks:
+                try:
+                    sink(rec)
+                except Exception:
+                    pass  # a renderer must never take the run down
+        return rec
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None and not self._f.closed:
+                self._f.flush()
+                self._f.close()
+            for sink in self._sinks:
+                close = getattr(sink, "close", None)
+                if close:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+
+
+def read_ledger(path: str, validate: bool = True) -> List[dict]:
+    """Parse a ledger file back into typed records (the round-trip half of
+    the schema contract: every line is a declared event carrying its
+    required fields)."""
+    out = []
+    with open(path) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if validate:
+                ev = rec.get("event")
+                required = EVENT_SCHEMA.get(ev)
+                if required is None:
+                    raise ValueError(
+                        f"{path}:{line_no}: undeclared event {ev!r}")
+                missing = [k for k in required if k not in rec]
+                if missing:
+                    raise ValueError(f"{path}:{line_no}: event {ev!r} "
+                                     f"missing {missing}")
+            out.append(rec)
+    return out
+
+
+class EpochCsvSink:
+    """Render 'epoch' events into the cookbook-parity per-epoch CSV
+    (reference 1.dataparallel.py:187-190 format [wall_start, seconds] +
+    the tpu_dist rate and peak-HBM columns). The CSV is now a VIEW of the
+    ledger's epoch record — same values, one source."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._f = None
+
+    def __call__(self, rec: dict) -> None:
+        if rec.get("event") != "epoch":
+            return
+        if self._f is None:
+            self._f = open(self._path, "a+", newline="")
+        csv.writer(self._f).writerow(
+            [rec["start_ts"], rec["seconds"],
+             round(rec["throughput"], 1), rec.get("hbm_bytes") or ""])
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None and not self._f.closed:
+            self._f.close()
+
+
+def _fmt(v, spec: str) -> str:
+    """Format a maybe-None numeric ledger field ('?' for None — schema
+    requires presence, not non-nullness)."""
+    return f"{v:{spec}}" if v is not None else "?"
+
+
+class ProgressSink:
+    """Render step/epoch/stall events as one-line text — the stdout
+    renderer flavor of the ledger (tools/ledger_report --tail uses it;
+    the in-loop progress line stays MeterBank's cookbook-format string,
+    fed from the same MeterBank.snapshot() read as the ledger)."""
+
+    def __init__(self, printer: Callable[[str], None] = print,
+                 every: int = 1):
+        self._print = printer
+        self._every = max(every, 1)
+
+    def __call__(self, rec: dict) -> None:
+        # every field is formatted None-tolerantly: the schema only pins
+        # PRESENCE, and all-None records are legal (ledger.py header)
+        ev = rec.get("event")
+        if ev == "step":
+            if (rec["step"] or 0) % self._every:
+                return
+            mfu = rec.get("mfu")
+            self._print(
+                f"step {rec['step']}: loss " + _fmt(rec["loss"], ".4f")
+                + f" {_fmt(rec['throughput'], ',.0f')} {rec['unit']}"
+                + (f" MFU {mfu * 100:.1f}%" if mfu else "")
+                + f" [data {_fmt(rec['data_s'], '.3f')}s dispatch "
+                  f"{_fmt(rec['dispatch_s'], '.3f')}s device "
+                  f"{_fmt(rec['device_s'], '.3f')}s]")
+        elif ev == "epoch":
+            self._print(f"epoch {rec['epoch']}: "
+                        f"loss {_fmt(rec['loss'], '.4f')} "
+                        f"{_fmt(rec['throughput'], ',.0f')} {rec['unit']} "
+                        f"({_fmt(rec['seconds'], '.1f')}s)")
+        elif ev == "stall":
+            self._print(f"STALL: no step for {_fmt(rec['idle_s'], '.1f')}s "
+                        f"(threshold {_fmt(rec['threshold_s'], '.1f')}s)")
+
+
+def phase_totals(records) -> Dict[str, float]:
+    """Sum the per-step phase seconds across a record list — the per-phase
+    time-share rollup ledger_report and bench publish."""
+    tot = {"data_s": 0.0, "dispatch_s": 0.0, "device_s": 0.0}
+    for rec in records:
+        if rec.get("event") != "step":
+            continue
+        for k in tot:
+            tot[k] += rec.get(k) or 0.0
+    return tot

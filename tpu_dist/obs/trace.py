@@ -1,0 +1,105 @@
+"""Step-phase tracer: host-side spans that line up with XLA traces.
+
+The loops need to know where a step's wall time went — data wait vs dispatch
+vs device block — every step and with ~zero overhead, not only when a
+profiler is attached. :class:`StepTracer` accumulates named host-side spans
+(``with tracer.span("data"): ...``) into a per-step dict the ledger's
+``step`` record carries; when a ``jax.profiler`` trace is active
+(``profile_dir`` set), the same spans also emit
+``jax.profiler.TraceAnnotation`` so the host phases appear as named regions
+on the XLA timeline, and :func:`step_annotation` wraps
+``StepTraceAnnotation`` so XLA's per-step grouping matches the ledger's
+step numbering.
+
+:func:`profile_session` replaces the two copy-pasted start/stop_trace
+blocks the engines grew in round 2: one context manager that starts the
+trace on entry and flushes it even on OOM/interrupt — a failing run is
+exactly the one worth profiling.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class StepTracer:
+    """Accumulating named spans for one step (or window) of host work.
+
+    Spans nest: a span opened inside another accumulates under the joined
+    path (``data`` -> ``data/decode``), and the parent's total includes the
+    child's time (wall-clock truth; the report subtracts if it wants
+    self-time). ``annotate=True`` additionally wraps each span in
+    ``jax.profiler.TraceAnnotation`` so host phases land on the XLA trace.
+
+    One tracer per loop; call :meth:`pop` at each step boundary to collect
+    {phase: seconds} and reset. :meth:`add` folds in externally measured
+    seconds (the boundary device_get block, timed where it happens).
+    """
+
+    def __init__(self, annotate: bool = False):
+        self.annotate = annotate
+        self._acc: Dict[str, float] = {}
+        self._stack = []
+
+    @contextmanager
+    def span(self, name: str):
+        path = "/".join(self._stack + [name])
+        self._stack.append(name)
+        ann = None
+        if self.annotate:
+            import jax.profiler
+            ann = jax.profiler.TraceAnnotation(path)
+            ann.__enter__()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            self._stack.pop()
+            self._acc[path] = self._acc.get(path, 0.0) + dt
+
+    def add(self, name: str, seconds: float) -> None:
+        """Fold externally measured seconds into a phase."""
+        self._acc[name] = self._acc.get(name, 0.0) + float(seconds)
+
+    def phases(self) -> Dict[str, float]:
+        return dict(self._acc)
+
+    def pop(self) -> Dict[str, float]:
+        """Collect the accumulated {phase: seconds} and reset for the next
+        step."""
+        out, self._acc = self._acc, {}
+        return out
+
+
+@contextmanager
+def step_annotation(step_num: int, enabled: bool = True):
+    """``jax.profiler.StepTraceAnnotation`` wrapper (no-op when disabled)
+    so XLA's per-step trace grouping carries the ledger's step number."""
+    if not enabled:
+        yield
+        return
+    import jax.profiler
+    with jax.profiler.StepTraceAnnotation("step", step_num=step_num):
+        yield
+
+
+@contextmanager
+def profile_session(profile_dir: str, enabled: bool = True):
+    """Start a ``jax.profiler`` trace into ``profile_dir`` and STOP IT ON
+    EVERY EXIT PATH (normal, OOM, interrupt). The engines' only device
+    tracing entry point since the round-6 obs refactor (both previously
+    carried their own start/stop_trace try/finally)."""
+    if not (profile_dir and enabled):
+        yield False
+        return
+    import jax.profiler
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield True
+    finally:
+        jax.profiler.stop_trace()

@@ -64,10 +64,23 @@ class MeterBank:
     def last(self, name: str) -> float:
         return self._stats[name][2]
 
-    def line(self, batch: int) -> str:
+    def snapshot(self) -> dict:
+        """One read of every field: {name: {"last": x, "avg": y}}.
+
+        THE shared view the loops feed both the progress printer and the
+        run ledger from (``line()`` renders from this same dict), so the
+        printed numbers and the recorded numbers can never drift — and
+        callers stop reaching into the private ``_stats``.
+        """
+        return {name: {"last": self.last(name), "avg": self.avg(name)}
+                for name, _ in self._fields}
+
+    def line(self, batch: int, snapshot: dict = None) -> str:
+        snap = snapshot if snapshot is not None else self.snapshot()
         w = len(str(self.total_batches))
         cells = [f"{self.prefix}[{batch:{w}d}/{self.total_batches}]"]
-        cells += [f"{name} {self.last(name):{spec}} ({self.avg(name):{spec}})"
+        cells += [f"{name} {snap[name]['last']:{spec}} "
+                  f"({snap[name]['avg']:{spec}})"
                   for name, spec in self._fields]
         return "\t".join(cells)
 

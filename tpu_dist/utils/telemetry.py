@@ -61,12 +61,39 @@ def program_hbm_bytes(jitted_fn, *args) -> Optional[int]:
     statement ORDER — the probe sits directly below the dispatch call in
     the same loop iteration (gated on ``_program_hbm is None`` so it runs
     once) — which also keeps the column on single-dispatch runs."""
+    return program_stats(jitted_fn, *args)["hbm_bytes"]
+
+
+def program_stats(jitted_fn, *args) -> dict:
+    """{'hbm_bytes', 'flops'} of ONE compiled program in ONE AOT
+    lower+compile (both the buffer assignment and the cost model read the
+    same executable, so probing them together halves the — cached, but not
+    free — lowering work). Same post-dispatch call-order contract as
+    :func:`program_hbm_bytes`. Either value is None when the backend does
+    not expose it; on a multi-step (lax.scan) window program the cost
+    model counts the scan body ONCE, so ``flops`` approximates one
+    optimizer step's FLOPs there, not the window's."""
+    out = {"hbm_bytes": None, "flops": None}
     try:
-        ma = jitted_fn.lower(*args).compile().memory_analysis()
-        return int(ma.argument_size_in_bytes + ma.output_size_in_bytes
-                   + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+        compiled = jitted_fn.lower(*args).compile()
     except Exception:
-        return None
+        return out
+    try:
+        ma = compiled.memory_analysis()
+        out["hbm_bytes"] = int(
+            ma.argument_size_in_bytes + ma.output_size_in_bytes
+            + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+    except Exception:
+        pass
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):  # older API: one dict per device program
+            cost = cost[0]
+        flops = float(cost["flops"])
+        out["flops"] = flops if flops > 0 else None
+    except Exception:
+        pass
+    return out
 
 
 def _host_rss_kb() -> Optional[int]:
@@ -80,28 +107,52 @@ def _host_rss_kb() -> Optional[int]:
     return None
 
 
-def start_hbm_sampler(path: str, interval_s: float = 0.5) -> Callable[[], None]:
+def start_hbm_sampler(path: str, interval_s: float = 0.5,
+                      ledger=None) -> Callable[[], None]:
     """Write `CSV_HEADER` rows to ``path`` every ``interval_s`` until the
-    returned stop() is called. Daemon thread: it never blocks exit."""
+    returned stop() is called. Daemon thread: it never blocks exit.
+
+    The returned stop() is idempotent and crash-safe: the file handle is
+    flushed+closed in the sampler thread's ``finally`` (so a sampler
+    exception still closes it exactly once), and repeated stop() calls are
+    no-ops after the first. When a run :class:`~tpu_dist.obs.ledger.Ledger`
+    is passed, each sample also lands there as an ``hbm`` event, so the
+    JSONL record carries the memory timeline alongside the step records.
+    """
     f = open(path, "w", buffering=1)
     f.write(CSV_HEADER + "\n")
     stop = threading.Event()
 
     def run():
-        dev = jax.local_devices()[0]
-        while not stop.is_set():
-            s = device_memory_stats(dev)
-            row = (time.time(), s.get("bytes_in_use", ""),
-                   s.get("peak_bytes_in_use", ""), s.get("bytes_limit", ""),
-                   _host_rss_kb() or "")
-            f.write(",".join(str(x) for x in row) + "\n")
-            stop.wait(interval_s)
-        f.close()
+        try:
+            dev = jax.local_devices()[0]
+            while not stop.is_set():
+                s = device_memory_stats(dev)
+                rss = _host_rss_kb()
+                row = (time.time(), s.get("bytes_in_use", ""),
+                       s.get("peak_bytes_in_use", ""),
+                       s.get("bytes_limit", ""), rss or "")
+                f.write(",".join(str(x) for x in row) + "\n")
+                if ledger is not None:
+                    ledger.emit("hbm",
+                                bytes_in_use=s.get("bytes_in_use"),
+                                peak_bytes=s.get("peak_bytes_in_use"),
+                                bytes_limit=s.get("bytes_limit"),
+                                host_rss_kb=rss)
+                stop.wait(interval_s)
+        finally:
+            # the ONLY close site: a second stop() or a sampler crash can
+            # neither double-close nor leave the handle open
+            if not f.closed:
+                f.flush()
+                f.close()
 
     t = threading.Thread(target=run, daemon=True)
     t.start()
 
     def stop_fn():
+        if stop.is_set():  # idempotent: later calls are no-ops
+            return
         stop.set()
         t.join(timeout=5)
 
