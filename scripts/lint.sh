@@ -1,0 +1,10 @@
+#!/bin/bash
+# Static-analysis gate: distlint over the acceptance surface, plus the
+# ledger-schema rule over tests/scripts. Stdlib-only (no jax, no devices),
+# so this runs anywhere — pre-commit, CI, a laptop. Non-zero exit on any
+# unsuppressed finding; suppressions require written reasons by design.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m tools.distlint tpu_dist tools bench.py "$@"
+python -m tools.distlint --select DL006 tests scripts

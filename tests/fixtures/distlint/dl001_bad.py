@@ -1,0 +1,29 @@
+"""DL001 positive fixture: collectives reachable on a subset of processes.
+
+Never imported or executed — linted only (tests/test_distlint.py). The
+directory is excluded from tree walks (distlint SKIP_DIRS), so the
+clean-tree sweep never sees these deliberate violations.
+"""
+
+import jax
+
+from tpu_dist.data import assemble_global
+from tpu_dist.engine import checkpoint as ckpt
+
+
+def gather_on_main_only(sharding, host_batch):
+    if jax.process_index() == 0:
+        # only process 0 enters the collective assembly -> the other
+        # hosts wait in their next collective forever
+        return assemble_global(sharding, host_batch)
+    return None
+
+
+def save_after_guarded_return(state, path, is_main):
+    if is_main:
+        pass
+    if jax.process_index() != 0:
+        return None
+    # everything from here on runs on process 0 only; the sharded-state
+    # gather inside save_checkpoint is collective
+    return ckpt.save_checkpoint(path, state, 0, 0.0, "lm", False)

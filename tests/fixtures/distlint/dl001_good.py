@@ -1,0 +1,21 @@
+"""DL001 negative fixture: the same calls, collectively-safe shapes."""
+
+import jax
+
+from tpu_dist.data import assemble_global
+from tpu_dist.engine import checkpoint as ckpt
+
+
+def gather_everywhere(sharding, host_batch):
+    out = assemble_global(sharding, host_batch)  # every process participates
+    if jax.process_index() == 0:
+        print("assembled")  # divergent guard around a PRINT is fine
+    return out
+
+
+def save_everywhere_then_log(state, path):
+    p = ckpt.save_checkpoint(path, state, 0, 0.0, "lm", False)
+    if jax.process_index() != 0:
+        return None
+    print("saved", p)  # only host-local work after the divergent return
+    return p

@@ -1,0 +1,14 @@
+"""DL002 positive fixture: blocking host syncs inside a hot step loop."""
+
+import jax
+import numpy as np
+
+
+def train_epoch(loader, step_fn, state):
+    for images, labels in loader:
+        state, metrics = step_fn(state, images, labels)
+        loss_sum = np.asarray(metrics["loss_sum"])     # implicit device_get
+        host = jax.device_get(metrics)                 # explicit sync
+        count = host["count"].item()                   # .item() sync
+        print(loss_sum / count)
+    return state

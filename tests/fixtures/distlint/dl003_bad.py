@@ -1,0 +1,16 @@
+"""DL003 positive fixture: axis names the mesh never declared."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def bad_specs(mesh):
+    # 'modle' is a typo for 'model' — every CPU test passes, XLA rejects
+    # it at trace time on the pod
+    a = NamedSharding(mesh, P("modle"))
+    b = P(None, "batch")                     # torch habit; axis is 'data'
+    return a, b
+
+
+def bad_collective(x):
+    return jax.lax.psum(x, "dataa")          # typo'd collective axis

@@ -1,0 +1,16 @@
+"""DL003 negative fixture: declared axes and variable axis names."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def good_specs(mesh, axis):
+    a = NamedSharding(mesh, P("data", "model"))
+    b = P(None, "fsdp")
+    c = P(("stage", "expert"), "seq")
+    d = P(axis)                              # dynamic: not statically checked
+    return a, b, c, d
+
+
+def good_collective(x, axis_name):
+    return jax.lax.psum(x, "data") + jax.lax.psum(x, axis_name)

@@ -1,0 +1,27 @@
+"""DL004 positive fixture: untraced side effects inside jitted code."""
+
+import time
+from functools import partial
+
+import jax
+
+
+@jax.jit
+def decorated_step(state, batch):
+    print("stepping", batch.shape)     # fires once at trace time, then never
+    t0 = time.time()                   # constant-folded into the program
+    return state, t0
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def donated_step(state, batch):
+    time.perf_counter()                # same hazard through partial(jit)
+    return state
+
+
+def make_step(ledger):
+    def inner(state, batch):
+        ledger.emit("step", step=0)    # a trace-time ledger write is a lie
+        return state
+
+    return jax.jit(inner)

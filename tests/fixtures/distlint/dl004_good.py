@@ -1,0 +1,28 @@
+"""DL004 negative fixture: traced-safe debugging + host-side effects."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def step(state, batch):
+    jax.debug.print("loss {l}", l=batch.sum())   # runs per execution
+    return state
+
+
+def make_host_step(ledger):
+    def inner(state, batch):
+        return state
+
+    wrapped = jax.jit(inner)
+
+    def host_step(state, batch):
+        t0 = time.time()               # host side of the dispatch: fine
+        out = wrapped(state, batch)
+        ledger.emit("step", step=0, loss=None, throughput=0.0, unit="x/s",
+                    data_s=0.0, dispatch_s=time.time() - t0, device_s=0.0,
+                    mfu=None)
+        return out
+
+    return host_step

@@ -1,0 +1,15 @@
+"""DL005 positive fixture: key reuse and global RNG state."""
+
+import jax
+import numpy as np
+
+
+def correlated_noise(key, shape):
+    noise = jax.random.normal(key, shape)
+    jitter = jax.random.uniform(key, shape)   # key reused: correlated draws
+    return noise, jitter
+
+
+def hidden_global_state(shape):
+    np.random.seed(0)                  # races with every other seed() caller
+    return np.random.rand(*shape)      # per-process hidden stream

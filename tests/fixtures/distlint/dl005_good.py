@@ -1,0 +1,28 @@
+"""DL005 negative fixture: split-per-consumer keys, seeded generators."""
+
+import jax
+import numpy as np
+
+
+def independent_noise(key, shape, train):
+    k_noise, k_jitter = jax.random.split(key)
+    noise = jax.random.normal(k_noise, shape)
+    jitter = jax.random.uniform(k_jitter, shape)
+    if train:
+        extra = jax.random.bernoulli(jax.random.fold_in(key, 1), 0.5, shape)
+    else:
+        extra = jax.random.bernoulli(jax.random.fold_in(key, 2), 0.5, shape)
+    return noise, jitter, extra
+
+
+def branch_local_reuse(key, shape, flip):
+    # only ONE arm executes: this is not a reuse
+    if flip:
+        return jax.random.normal(key, shape)
+    else:
+        return jax.random.uniform(key, shape)
+
+
+def seeded_host_rng(shape, seed):
+    rng = np.random.default_rng(seed)          # the sanctioned numpy path
+    return rng.random(shape)

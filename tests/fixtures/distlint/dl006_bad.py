@@ -1,0 +1,8 @@
+"""DL006 positive fixture: ledger emit() schema violations."""
+
+
+def emit_badly(ledger, name, fields):
+    ledger.emit("no_such_event", x=1)          # undeclared event
+    ledger.emit(name, step=1)                  # computed event name
+    ledger.emit("step", **fields)              # required fields in a splat
+    ledger.emit()                              # no event at all

@@ -1,0 +1,12 @@
+"""DL006 negative fixture: conformant emit() call sites."""
+
+
+def emit_well(ledger, extra):
+    ledger.emit("compile", program="train_step", flops=None)
+    ledger.emit("run_end", steps=3, seconds=1.5, **extra)  # extras may splat
+    return ledger
+
+
+def forward_wrapper(led, event, fields):
+    # declared forwarding wrapper: re-exposes emit()'s own signature
+    return led.emit(event, **fields)  # ledger-schema: forward

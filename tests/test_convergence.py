@@ -9,6 +9,7 @@ recorded step budget. Bound = recorded steps + margin, per SURVEY.md §4
 """
 
 import jax
+import pytest
 
 from tpu_dist.configs import TrainConfig
 from tpu_dist.engine import Trainer
@@ -42,13 +43,16 @@ def test_jit_fp32_converges_within_bound(tmp_path):
     assert _converges("jit", "fp32", str(tmp_path)) <= BOUND_STEPS
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_jit_bf16_converges_within_bound(tmp_path):
     assert _converges("jit", "bf16", str(tmp_path)) <= BOUND_STEPS
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_shard_map_converges_within_bound(tmp_path):
     assert _converges("shard_map", "fp32", str(tmp_path)) <= BOUND_STEPS
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_windowed_dispatch_converges_within_bound(tmp_path):
     assert _converges("jit", "bf16", str(tmp_path), k=8) <= BOUND_STEPS

@@ -6,6 +6,7 @@ cudnn flags with a documented perf warning, 1.dataparallel.py:78-86).
 """
 
 import jax
+import pytest
 import numpy as np
 
 from tpu_dist.configs import TrainConfig
@@ -30,6 +31,7 @@ def test_same_seed_reproduces_bitwise(tmp_path):
     np.testing.assert_array_equal(p1, p2)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_different_seed_differs(tmp_path):
     _, p1 = _run(123, str(tmp_path / "a"))
     _, p2 = _run(124, str(tmp_path / "b"))

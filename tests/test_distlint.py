@@ -1,0 +1,321 @@
+"""distlint: rule fixtures, suppressions, JSON output, and the tier-1
+clean-tree pin.
+
+Every rule must flag its bad fixture (tests/fixtures/distlint/dlNNN_bad.py)
+and stay silent on the good twin — a rule that cannot fire is worse than no
+rule, because it pins a false "clean". The fixtures directory is excluded
+from directory walks (distlint SKIP_DIRS), so the clean-tree sweep below
+never sees the deliberate violations; fixtures are linted by explicit file
+path only.
+
+No jax import anywhere in this file: distlint is stdlib-only by contract,
+and this suite must stay cheap inside the tier-1 budget.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.distlint import RULES, lint_files, load_mesh_axes
+from tools.distlint.core import REPO_ROOT, parse_suppressions
+from tools.distlint.__main__ import main as distlint_main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "distlint")
+RULE_IDS = [r.id for r in RULES]
+
+# every rule must produce EXACTLY this many findings on its bad fixture —
+# an extra finding is a false positive creeping into the rule, a missing
+# one is a detection regression; both should fail loudly here
+EXPECTED_BAD_COUNTS = {"DL001": 2, "DL002": 3, "DL003": 3,
+                       "DL004": 4, "DL005": 3, "DL006": 4}
+
+
+def lint_fixture(name: str, rule_id: str):
+    return lint_files([os.path.join(FIXTURES, name)], select=[rule_id])
+
+
+# ------------------------------------------------------------ rule pairs
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fires_on_bad_fixture(rule_id):
+    res = lint_fixture(f"dl{rule_id[2:]}_bad.py", rule_id)
+    assert len(res.findings) == EXPECTED_BAD_COUNTS[rule_id], \
+        [f.render() for f in res.findings]
+    for f in res.findings:
+        assert f.rule == rule_id
+        assert f.line > 0 and f.message
+        assert f.path.endswith(f"dl{rule_id[2:]}_bad.py")
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_silent_on_good_fixture(rule_id):
+    res = lint_fixture(f"dl{rule_id[2:]}_good.py", rule_id)
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+def test_rules_have_distinct_ids_and_docs():
+    assert len(RULE_IDS) == len(set(RULE_IDS)) >= 6
+    for r in RULES:
+        assert r.title and r.rationale
+
+
+# ----------------------------------------------------------- suppression
+def _write(tmp_path, text):
+    p = tmp_path / "snippet.py"
+    p.write_text(text)
+    return str(p)
+
+
+BAD_LOOP = ("import jax\n"
+            "def train_epoch(it, step, state):\n"
+            "    for b in it:\n"
+            "        state, m = step(state, b)\n"
+            "        jax.device_get(m){}\n"
+            "    return state\n")
+
+
+def test_trailing_suppression_with_reason(tmp_path):
+    path = _write(tmp_path, BAD_LOOP.format(
+        "  # distlint: disable=DL002 -- test: deliberate sync"))
+    res = lint_files([path], select=["DL002"])
+    assert res.findings == []
+    ((finding, sup),) = res.suppressed
+    assert finding.rule == "DL002" and sup.reason == "test: deliberate sync"
+
+
+def test_standalone_suppression_applies_to_next_line(tmp_path):
+    lines = BAD_LOOP.format("").splitlines()
+    lines.insert(4, "        # distlint: disable=DL002 -- test: deliberate "
+                    "sync on next line")
+    path = _write(tmp_path, "\n".join(lines) + "\n")
+    res = lint_files([path], select=["DL002"])
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+
+
+def test_suppression_without_reason_is_itself_a_finding(tmp_path):
+    path = _write(tmp_path, BAD_LOOP.format(
+        "  # distlint: disable=DL002"))
+    res = lint_files([path], select=["DL002"])
+    rules = sorted(f.rule for f in res.findings)
+    # the reasonless disable does NOT suppress, and is flagged as DL000
+    assert rules == ["DL000", "DL002"], [f.render() for f in res.findings]
+    assert "reason" in next(f for f in res.findings
+                            if f.rule == "DL000").message
+
+
+def test_suppression_for_other_rule_does_not_apply(tmp_path):
+    path = _write(tmp_path, BAD_LOOP.format(
+        "  # distlint: disable=DL001 -- wrong rule id"))
+    res = lint_files([path], select=["DL002"])
+    assert [f.rule for f in res.findings] == ["DL002"]
+    assert res.suppressed == []
+
+
+def test_multi_rule_suppression_parses():
+    sups, malformed = parse_suppressions(
+        "x = 1  # distlint: disable=DL001,DL005 -- both rules, one reason\n")
+    assert malformed == []
+    assert sups[0].rules == ("DL001", "DL005")
+    assert sups[0].line == 1
+
+
+def test_prose_mentioning_distlint_is_not_a_directive():
+    sups, malformed = parse_suppressions(
+        "# this comment mentions distlint casually, not as a directive\n"
+        "x = 1\n")
+    assert sups == [] and malformed == []
+
+
+def test_unparseable_file_is_reported_not_crashed(tmp_path):
+    path = _write(tmp_path, "def broken(:\n")
+    res = lint_files([path])
+    assert [f.rule for f in res.findings] == ["DL000"]
+    assert "unparseable" in res.findings[0].message
+
+
+# ------------------------------------------------------------ CLI + JSON
+def test_cli_json_round_trip(tmp_path, capsys):
+    bad = os.path.join(FIXTURES, "dl003_bad.py")
+    rc = distlint_main(["--json", "--select", "DL003", bad])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    api = lint_files([bad], select=["DL003"])
+    assert payload["findings"] == [f.to_json() for f in api.findings]
+    assert payload["files_checked"] == 1
+    for f in payload["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message"}
+
+
+def test_cli_exit_codes(capsys):
+    assert distlint_main(["--select", "DL001",
+                          os.path.join(FIXTURES, "dl001_good.py")]) == 0
+    assert distlint_main(["--select", "DL001",
+                          os.path.join(FIXTURES, "dl001_bad.py")]) == 1
+    assert distlint_main(["--select", "DL999", "tools"]) == 2
+    assert distlint_main(["--list-rules"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_module_entry_point():
+    """`python -m tools.distlint` works from the repo root (no jax)."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.distlint", "--list-rules"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    for rid in RULE_IDS:
+        assert rid in out.stdout
+
+
+# --------------------------------------------- review-found regressions
+def test_dl004_factory_host_side_build_code_is_not_flagged(tmp_path):
+    """jit(make_step(...)) traces what the factory RETURNS; the factory's
+    own body is host-side build code and may print/time freely."""
+    path = _write(tmp_path, (
+        "import time\n"
+        "import jax\n"
+        "def make_step(cfg):\n"
+        "    print('building', cfg)\n"          # host side: legal
+        "    t0 = time.time()\n"                # host side: legal
+        "    def step(state, batch):\n"
+        "        print('stepping')\n"           # traced: flagged
+        "        return state\n"
+        "    return step\n"
+        "train = jax.jit(make_step(1))\n"))
+    res = lint_files([path], select=["DL004"])
+    assert len(res.findings) == 1, [f.render() for f in res.findings]
+    assert res.findings[0].line == 7
+
+
+def test_dl001_function_defined_under_guard_is_not_flagged(tmp_path):
+    """A function merely DEFINED under a divergent guard may be called on
+    every host — only calls executing under the guard are hazards."""
+    path = _write(tmp_path, (
+        "import jax\n"
+        "def setup():\n"
+        "    if jax.process_index() == 0:\n"
+        "        def helper(x):\n"
+        "            return jax.lax.psum(x, 'data')\n"
+        "        return helper\n"
+        "    return None\n"))
+    res = lint_files([path], select=["DL001"])
+    assert res.findings == [], [f.render() for f in res.findings]
+
+
+def test_dl001_guarded_return_inside_with_block_propagates(tmp_path):
+    """A process_index-guarded early return inside a with/try block makes
+    the code after that block host-divergent too."""
+    path = _write(tmp_path, (
+        "import jax\n"
+        "def save(state, sharding, batch, f):\n"
+        "    with open(f) as fh:\n"
+        "        if jax.process_index() != 0:\n"
+        "            return None\n"
+        "    from tpu_dist.data import assemble_global\n"
+        "    return assemble_global(sharding, batch)\n"))
+    res = lint_files([path], select=["DL001"])
+    assert [f.rule for f in res.findings] == ["DL001"]
+
+
+def test_dl003_axis_index_first_positional_arg(tmp_path):
+    path = _write(tmp_path, (
+        "import jax\n"
+        "def idx():\n"
+        "    good = jax.lax.axis_index('data')\n"
+        "    return good + jax.lax.axis_index('modle')\n"))
+    res = lint_files([path], select=["DL003"])
+    assert len(res.findings) == 1 and "modle" in res.findings[0].message
+
+
+def test_dl005_stdlib_rng_through_alias_and_from_import(tmp_path):
+    path = _write(tmp_path, (
+        "import random as rnd\n"
+        "from random import randint\n"
+        "def draw():\n"
+        "    return rnd.random() + randint(0, 3)\n"))
+    res = lint_files([path], select=["DL005"])
+    assert len(res.findings) == 2, [f.render() for f in res.findings]
+
+
+def test_shim_check_file_honors_dl006_suppressions(tmp_path):
+    from tools.check_ledger_schema import check_file, load_schema
+    p = tmp_path / "emits.py"
+    p.write_text(
+        "ledger.emit('bogus', x=1)  "
+        "# distlint: disable=DL006 -- test: deliberately undeclared\n"
+        "ledger.emit('also_bogus', x=1)\n")
+    out = check_file(str(p), load_schema(), "emits.py")
+    assert len(out) == 1 and "also_bogus" in out[0]
+
+
+def test_trailing_suppression_on_multiline_statement(tmp_path):
+    """A formatter may wrap the flagged call across lines, leaving the
+    trailing comment on a continuation line; the suppression must still
+    cover the whole statement (findings anchor to the first line)."""
+    path = _write(tmp_path, (
+        "import jax\n"
+        "def train_epoch(it, step, state):\n"
+        "    for b in it:\n"
+        "        state, m = step(state, b)\n"
+        "        jax.device_get(\n"
+        "            m)  # distlint: disable=DL002 -- test: deliberate sync\n"
+        "    return state\n"))
+    res = lint_files([path], select=["DL002"])
+    assert res.findings == [] and len(res.suppressed) == 1
+
+
+def test_dl002_hot_func_names_all_exist_in_tree():
+    """Every name the hot-path regex matches must actually occur as a
+    function in the tree — a dead alternative gives false assurance that
+    a surface is linted when nothing matches it."""
+    import ast as ast_mod
+    from tools.distlint.rules import HotLoopHostSync
+    names = set()
+    for d in ("tpu_dist",):
+        for root, _, files in os.walk(os.path.join(REPO_ROOT, d)):
+            for f in files:
+                if not f.endswith(".py"):
+                    continue
+                with open(os.path.join(root, f)) as fh:
+                    tree = ast_mod.parse(fh.read())
+                names |= {n.name for n in ast_mod.walk(tree)
+                          if isinstance(n, ast_mod.FunctionDef)}
+    pattern = HotLoopHostSync.HOT_FUNC_RE.pattern
+    alternatives = pattern.strip("^$()").split("|")
+    for alt in alternatives:
+        assert alt in names, f"HOT_FUNC_RE lists {alt!r}: no such function"
+
+
+def test_dl001_tensor_rank_comparison_is_not_divergent(tmp_path):
+    path = _write(tmp_path, (
+        "import jax\n"
+        "def reduce_if_matrix(t, x):\n"
+        "    if t.rank == 2:\n"                    # tensor rank, not process
+        "        return jax.lax.psum(x, 'data')\n"
+        "    return x\n"
+        "def main_only(rank, sharding, batch):\n"
+        "    from tpu_dist.data import assemble_global\n"
+        "    if rank == 0:\n"                      # bare rank: process guard
+        "        return assemble_global(sharding, batch)\n"))
+    res = lint_files([path], select=["DL001"])
+    assert len(res.findings) == 1 and res.findings[0].line == 9
+
+
+# ------------------------------------------------------- tree invariants
+def test_mesh_axes_authority_loaded():
+    axes = load_mesh_axes()
+    assert {"data", "fsdp", "model", "seq", "stage", "expert"} <= axes
+
+
+def test_tree_is_clean():
+    """THE tier-1 pin: zero unsuppressed findings across the acceptance
+    surface (tpu_dist, tools, bench.py — all rules) plus tests/scripts
+    for the ledger-schema rule, and every suppression carries a reason."""
+    res = lint_files(["tpu_dist", "tools", "bench.py"])
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
+    for finding, sup in res.suppressed:
+        assert sup.reason.strip(), finding.render()
+    res6 = lint_files(["tests", "scripts"], select=["DL006"])
+    assert res6.findings == [], "\n".join(f.render() for f in res6.findings)

@@ -103,6 +103,7 @@ def test_jit_and_shard_map_flavors_agree_exactly():
                                rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_single_vs_multi_device_same_update():
     """Data parallelism must not change the math: 1-device mesh and 8-device
     mesh see the same global batch -> same params after one step."""
@@ -298,6 +299,7 @@ def _trainer_params(tmp, k, placement="auto", epochs=1):
                                for x in jax.tree.leaves(tr.state.params)])
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_trainer_windowed_device_data_matches_per_batch(tmp_path):
     """steps_per_dispatch=4 + HBM-resident dataset == the per-batch loop."""
     tr1, p1 = _trainer_params(str(tmp_path / "a"), k=1)
@@ -349,6 +351,7 @@ def test_trainer_grad_accum_wiring(tmp_path):
                             grad_accum_steps=2, steps_per_dispatch=4))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_trainer_windowed_mid_epoch_resume_step_exact(tmp_path):
     """Interrupt between windows, resume -> same params as uninterrupted."""
     import os

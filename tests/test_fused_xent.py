@@ -87,6 +87,7 @@ def test_bf16_compute_close_to_fp32():
     np.testing.assert_allclose(float(loss16), float(loss32), rtol=2e-2)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_lm_trainer_loss_chunk_matches(tmp_path):
     """--loss-chunk N trains to the SAME parameters as the full-logits path
     (fp32, same seed) in the jit mode, and sp with loss_chunk agrees with
@@ -129,6 +130,7 @@ def test_lm_trainer_loss_chunk_eval_exact(tmp_path):
     np.testing.assert_allclose(acc_c, acc_f, rtol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_loss_chunk_under_tensor_parallel_matches_dp():
     """The chunked CE under Megatron TP: the head kernel arrives 'model'-
     sharded and GSPMD partitions the chunked scan's matmul + logsumexp —
@@ -186,6 +188,7 @@ def test_loss_chunk_under_tensor_parallel_matches_dp():
                                    rtol=2e-4, atol=1e-5, err_msg=k)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_lm_trainer_pp_loss_chunk_matches(tmp_path):
     """--loss-chunk in the gpipe pipeline (the last-stage chunked head,
     round 4) trains to the same parameters as the pp full-logits path."""
@@ -208,6 +211,7 @@ def test_lm_trainer_pp_loss_chunk_matches(tmp_path):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_loss_chunk_under_fsdp_matches_dp():
     """Chunked CE under ZeRO-3 (fsdp) placement: the head kernel arrives
     parameter-sharded over 'data' and GSPMD gathers it per chunk — one

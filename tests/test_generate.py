@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpu_dist.engine.generate import generate
 from tpu_dist.engine.lm_steps import make_lm_batches, make_lm_train_step
@@ -43,6 +44,7 @@ def test_sampling_uses_rng():
     assert not np.array_equal(np.asarray(a[:, 4:]), np.asarray(b[:, 4:]))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_trained_lm_generates_the_learned_rule():
     """Train on the affine next-token stream (x -> 5x+7 mod V, the script-8
     dataset), then greedy generation must follow the rule."""
@@ -183,6 +185,7 @@ def test_mesh_tp_decode_rejects_indivisible_heads():
         generate(lm, params, jnp.ones((1, 4), jnp.int32), steps=4, mesh=mesh)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_mesh_sampled_decode_reproduces_replicated_rng():
     """temperature>0 under a data mesh: the rng is replicated, so sampling
     is still deterministic given the key, and matches single-device."""
@@ -207,6 +210,7 @@ def _moe_and_params(seed=0, **kw):
     return moe, params
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_moe_cached_decode_matches_full_recompute():
     """MoE KV-cache decode == full recompute under drop-free capacity
     (capacity_factor >= E/k): per-expert capacity is group-LENGTH-dependent
@@ -244,6 +248,7 @@ def test_moe_cached_decode_batched_is_valid():
     assert int(jnp.min(out)) >= 0 and int(jnp.max(out)) < V
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_moe_top2_cached_decode_matches_full():
     moe, params = _moe_and_params(seed=24, router_top_k=2,
                                   capacity_factor=1.0)  # top-2: E/k = 1
@@ -253,6 +258,7 @@ def test_moe_top2_cached_decode_matches_full():
     np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_moe_ep_sharded_decode_matches_single_device():
     """EP decode: expert weights sharded over 'expert' (GShard dispatch
     all-to-alls via GSPMD) emit the same greedy tokens as single-device,

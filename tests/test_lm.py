@@ -78,6 +78,7 @@ def test_tp_matches_dp(setup):
     assert _loss(m) == pytest.approx(loss_dp, abs=2e-4)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_sp_ring_matches_dp(setup):
     model, params, tx, inputs, targets = setup
     _, loss_dp = _run_dp(setup, make_mesh((8,), ("data",)))
@@ -97,6 +98,7 @@ def test_sp_ring_matches_dp(setup):
     np.testing.assert_allclose(fa, fb, rtol=2e-3, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_lm_learns_structured_sequence():
     """Convergence smoke: deterministic next-token rule is learnable fast."""
     mesh = make_mesh((8,), ("data",))

@@ -47,6 +47,7 @@ def test_lm_windowed_matches_per_batch(tmp_path):
     np.testing.assert_allclose(p1, p4, rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_lm_modes_agree_over_epoch(tmp_path):
     """dp == tp == sp == pp at the end of a FULL epoch over the corpus —
     the round-2 tests only checked single steps on a fixed batch."""
@@ -82,6 +83,7 @@ def test_lm_modes_agree_over_epoch(tmp_path):
     dict(mesh_shape=(4, 2), mesh_axes=("data", "stage"), pp_microbatches=2,
          pp_schedule="1f1b"),
 ])
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_lm_shard_mode_windowed_matches_per_batch(mesh_kw):
     """VERDICT r3 #3: sp and pp get the K-steps-per-dispatch HBM-resident
     window path (lax.scan over index windows INSIDE the shard_map program);
@@ -119,6 +121,7 @@ def test_lm_grad_accum_matches_full_batch():
                            mesh_axes=("data", "seq"), **TINY))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_lm_mid_epoch_resume_step_exact(tmp_path):
     """Interrupt between windows, resume -> same params as uninterrupted."""
     kw = dict(steps_per_dispatch=2, checkpoint_dir=str(tmp_path / "full"),
@@ -150,6 +153,7 @@ def test_lm_mid_epoch_resume_step_exact(tmp_path):
     np.testing.assert_allclose(p_full, p_res, rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_lm_lr_schedule_survives_resume(tmp_path):
     """Warmup+cosine LR trajectory continues exactly across a --resume
     boundary (VERDICT r3 #2): interrupt mid-schedule, resume, and the final
@@ -241,6 +245,7 @@ def test_lm_max_steps_caps_run():
     assert int(jax.device_get(tr.state.step)) == 3
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_lm_adamw_trains_and_resumes(tmp_path):
     """--optimizer adamw: a checkpoint/resume boundary after epoch 1
     continues the EXACT 2-epoch trajectory (the mu/nu moments ride in the

@@ -229,6 +229,7 @@ def test_ep_actually_shards_expert_compute():
     assert w.addressable_shards[0].data.shape[0] == 1  # 8 experts / 8 devs
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_moe_remat_matches_no_remat(moe_setup):
     """--remat with MoE (VERDICT r3 #4): per-block rematerialization must
     change memory, never math — identical loss/metrics and updated params,
@@ -361,6 +362,7 @@ def test_moe_training_reports_router_mass(tmp_path):
     assert 0.0 < metrics["rmass"] <= 1.0 + 1e-5
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_moe_sp_composition_matches_dp():
     """MoE + sequence parallelism (round 4): with a router group size that
     divides the shard's tokens, sp grouping partitions each row into the
@@ -415,6 +417,7 @@ def test_moe_sp_composition_matches_dp():
                                    rtol=2e-4, atol=1e-5, err_msg=k)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_moe_sp_trains_via_lm_trainer():
     """LMTrainer accepts data=2,seq=4 + --num-experts (the round-3 'not
     supported yet' rejection is gone) and trains + evaluates end to end."""
@@ -433,6 +436,7 @@ def test_moe_sp_trains_via_lm_trainer():
     assert np.isfinite(loss) and ppl < 64  # better than uniform
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_moe_pp_gpipe_matches_dp():
     """MoE + pipeline (round 4, GPipe only): 4 MoE blocks over 4 stages,
     aux_weight=0 and a group size dividing each row's segments — one
@@ -483,6 +487,7 @@ def test_moe_pp_gpipe_matches_dp():
                                    rtol=2e-4, atol=1e-5, err_msg=k)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 def test_moe_pp_trains_via_lm_trainer(schedule):
     """LMTrainer drives MoE x pp end to end (aux ON) under BOTH schedules —
@@ -508,6 +513,15 @@ def test_moe_pp_tp_trains_via_lm_trainer():
     round-5 composition reachable end to end, not just via the pp.py
     makers (guard regression: the 'MoE + pure tensor parallelism' check
     must exempt pipeline meshes)."""
+    from tpu_dist._compat import PARTIAL_MANUAL_SHARD_MAP
+    if not PARTIAL_MANUAL_SHARD_MAP:
+        # the same gate test_pp's pp x tp test carries (PR 1 contract:
+        # _pp_shard_map raises cleanly on old jax, tests skip) — it was
+        # missing here and only surfaced once the tier-1 budget fix let
+        # the suite actually reach this file
+        pytest.skip("pp x tp needs partial-manual shard_map (jax >= 0.6); "
+                    "this jax's experimental shard_map aborts in the SPMD "
+                    "partitioner (_compat.PARTIAL_MANUAL_SHARD_MAP)")
     from tpu_dist.configs import LMConfig
     from tpu_dist.engine.lm_loop import LMTrainer
 
@@ -589,6 +603,13 @@ def test_moe_pp_tp_matches_pp(schedule):
     ON, so the only variable is the 'model' partitioning (pp == dp is
     covered by test_moe_pp_gpipe_matches_dp; aux is schedule-geometry
     dependent, see test_moe_pp_1f1b_matches_gpipe_with_aux)."""
+    from tpu_dist._compat import PARTIAL_MANUAL_SHARD_MAP
+    if not PARTIAL_MANUAL_SHARD_MAP:
+        # see test_moe_pp_tp_trains_via_lm_trainer: the test_pp gate,
+        # restored here once tier-1 started reaching this file
+        pytest.skip("pp x tp needs partial-manual shard_map (jax >= 0.6); "
+                    "this jax's experimental shard_map aborts in the SPMD "
+                    "partitioner (_compat.PARTIAL_MANUAL_SHARD_MAP)")
     from tpu_dist.parallel.pp import (make_lm_pp_1f1b_train_step,
                                       make_lm_pp_train_step,
                                       shard_state_pp, stack_pipeline_params,
@@ -641,6 +662,7 @@ def test_moe_pp_tp_matches_pp(schedule):
                                    err_msg=f"{schedule} {k}")
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_moe_aux_weight_flag_reaches_objective():
     """--moe-aux-weight threads into the training objective: zero weight
     trains different parameters than the 0.01 default (same seed), and the

@@ -160,6 +160,7 @@ def test_grad_clip_zero_keeps_adamw_state_structure():
             == jax.tree_util.tree_structure(st_plain))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 def test_grad_clip_pp_matches_dp(schedule):
     """--grad-clip under pipeline parallelism (round 5 — was rejected in
@@ -195,6 +196,7 @@ def test_grad_clip_pp_matches_dp(schedule):
     np.testing.assert_allclose(vec(pp), vec(dp), rtol=2e-3, atol=1e-4)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_grad_clip_sp_matches_dp():
     """--grad-clip under sequence parallelism: sp grads are pmean'd to the
     FULL gradient before the update runs, so every device clips by the same

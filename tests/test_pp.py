@@ -63,8 +63,12 @@ def _maker(schedule):
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 @pytest.mark.parametrize("mesh_shape,axes,microbatches", [
     ((1, 4), ("data", "stage"), 4),   # pure pipeline
-    ((2, 4), ("data", "stage"), 2),   # dp x pp
-    ((2, 2), ("data", "stage"), 4),   # 2 blocks per stage
+    # tier-1 budget (PR 3): the dp x pp and blocks-per-stage layouts are
+    # heavy near-duplicates of the pure-pp parity; slow-marked
+    pytest.param((2, 4), ("data", "stage"), 2,
+                 marks=pytest.mark.slow),   # dp x pp
+    pytest.param((2, 2), ("data", "stage"), 4,
+                 marks=pytest.mark.slow),   # 2 blocks per stage
 ])
 def test_pp_step_matches_dp(mesh_shape, axes, microbatches, schedule):
     """Either pipeline schedule == plain DP, loss/metrics/params — a

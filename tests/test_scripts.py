@@ -44,6 +44,7 @@ def test_script_1_dataparallel(tmp_path):
     assert os.path.exists(tmp_path / "dataparallel.csv")  # C21 CSV default
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_script_2_distributed(tmp_path):
     out = run_script(tmp_path, "2.distributed.py", TINY + ck(tmp_path))
     assert "rendezvous=local" in out and "best_acc1" in out
@@ -60,17 +61,20 @@ def test_script_3_spawn_two_processes(tmp_path):
     assert "best_acc1" in out
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_script_4_bf16(tmp_path):
     out = run_script(tmp_path, "4.bf16_distributed.py", TINY + ck(tmp_path))
     assert "best_acc1" in out
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_script_5_allreduce(tmp_path):
     out = run_script(tmp_path, "5.allreduce_distributed.py",
                      TINY + ck(tmp_path))
     assert "best_acc1" in out
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_script_5_2_mnist(tmp_path):
     out = run_script(tmp_path, "5.2.mnist.py", TINY + ck(tmp_path))
     assert "best_acc1" in out
@@ -83,6 +87,7 @@ def test_script_6_slurm_fallback_local(tmp_path):
     assert os.path.exists(tmp_path / "distributed.csv")
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_script_7_flagship_windowed(tmp_path):
     # keep the flagship's windowed dispatch path (K>1) but shrink the model
     out = run_script(tmp_path, "7.jax_tpu.py",
@@ -91,6 +96,7 @@ def test_script_7_flagship_windowed(tmp_path):
     assert os.path.exists(tmp_path / "jax_tpu.csv")
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_script_8_lm(tmp_path):
     out = run_script(tmp_path, "8.lm_longcontext.py",
                      ["--steps", "3", "--batch-size", "4", "--seq-len", "32",
@@ -104,6 +110,7 @@ def test_script_8_lm(tmp_path):
     assert "affine rule" in out    # --generate surface
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_script_8_lm_pipeline_mode(tmp_path):
     out = run_script(tmp_path, "8.lm_longcontext.py",
                      ["--mesh", "data=2,stage=2", "--steps", "3",
@@ -123,6 +130,7 @@ def test_script_evaluate_flag(tmp_path):
     assert "best_acc1" in out
 
 
+@pytest.mark.slow  # tier-1 budget (PR 3): heavy; covered by cheaper siblings in-budget
 def test_tool_lm_convergence(tmp_path):
     out = run_script(tmp_path, "../tools/lm_convergence.py",
                      ["--synth-tokens", "60000", "--batch-size", "16",

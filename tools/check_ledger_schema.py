@@ -1,126 +1,72 @@
 #!/usr/bin/env python
-"""Static ledger-schema check: every ``*.emit(...)`` call site conforms.
+"""Thin shim over distlint rule DL006 (the ledger-schema check's new home).
 
-Walks the tree's Python ASTs (no imports of jax — or of anything else from
-the checked modules: the schema itself is extracted from
-``tpu_dist/obs/ledger.py`` by AST too) and verifies, for every call of the
-form ``<something named ...ledger...>.emit(...)``:
+The original AST walker grew into ``tools/distlint`` — a whole-tree
+SPMD-correctness linter — and this check became its rule DL006, so there
+is exactly ONE AST walker to maintain. This entry point stays for
+callers/CI muscle memory and keeps the original API surface:
 
-* the event name is a LITERAL string naming a declared ``EVENT_SCHEMA``
-  event (a computed event name defeats static checking — declare a new
-  event instead);
-* every required field of that event appears as an explicit keyword (a
-  bare ``**fields`` splat hides required fields from the checker, so only
-  the NON-required extras may ride in a splat — except for forwarding
-  wrappers that re-expose ``emit``'s own signature, which declare
-  themselves via a ``# ledger-schema: forward`` comment on the call line).
+* :func:`load_schema` — EVENT_SCHEMA extracted from ledger.py by AST;
+* :func:`check_file` — one file's violations as ``rel:line: msg`` strings;
+* :func:`check_tree` — the historical sweep (tpu_dist, tools, tests,
+  scripts, bench.py), same string format;
+* CLI: ``python tools/check_ledger_schema.py [root]`` — prints violations,
+  exits non-zero if any.
 
-Wired into tier-1 as a plain test (tests/test_obs.py) so schema drift —
-a renamed field, an undeclared event — fails fast at review time, not at
-3am when someone greps a ledger.
-
-CLI: ``python tools/check_ledger_schema.py [root]`` — prints violations,
-exits non-zero if any.
+``# ledger-schema: forward`` on a call line still declares a forwarding
+wrapper (distlint's DL006 honors it), and ``# distlint: disable=DL006 --
+reason`` now works too.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:  # script invocation: make 'tools.distlint' importable
+    sys.path.insert(0, ROOT)
+
+from tools.distlint.core import (FileContext, load_event_schema,  # noqa: E402
+                                 lint_files, parse_suppressions)
+from tools.distlint.rules import check_emit_calls  # noqa: E402
+
 SCHEMA_FILE = os.path.join("tpu_dist", "obs", "ledger.py")
-# directories whose .py files are checked (tests included: a test emitting
-# a drifted record would otherwise pin the drift as "expected")
 CHECKED = ("tpu_dist", "tools", "tests", "scripts")
 CHECKED_FILES = ("bench.py",)
 FORWARD_MARK = "ledger-schema: forward"
 
 
 def load_schema(root: str = ROOT) -> dict:
-    """EVENT_SCHEMA extracted from ledger.py source by AST — the dict is a
-    pure literal by contract (see its definition comment)."""
-    src = open(os.path.join(root, SCHEMA_FILE)).read()
-    for node in ast.walk(ast.parse(src)):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "EVENT_SCHEMA":
-                    return ast.literal_eval(node.value)
-    raise AssertionError(f"EVENT_SCHEMA literal not found in {SCHEMA_FILE}")
-
-
-def _terminal_name(func_value) -> str:
-    """The receiver's final name: ``self.obs.ledger`` -> 'ledger',
-    ``led`` -> 'led'."""
-    node = func_value
-    while isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return ""
-
-
-def _is_ledger_emit(call: ast.Call) -> bool:
-    f = call.func
-    if not (isinstance(f, ast.Attribute) and f.attr == "emit"):
-        return False
-    name = _terminal_name(f.value).lower()
-    # 'led' included: the natural short name must not dodge the checker
-    return "ledger" in name or name == "led"
+    return load_event_schema(root)
 
 
 def check_file(path: str, schema: dict, rel: str) -> list:
-    src = open(path).read()
+    """One file's DL006 violations in the historical string format.
+    Honors the same suppressions as the lint gate (`# distlint:
+    disable=DL006 -- reason`), so the two API surfaces always agree."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
     try:
-        tree = ast.parse(src)
+        ctx = FileContext(path, rel, src)
     except SyntaxError as e:
         return [f"{rel}: unparseable ({e})"]
-    lines = src.splitlines()
-    out = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call) and _is_ledger_emit(node)):
-            continue
-        where = f"{rel}:{node.lineno}"
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if FORWARD_MARK in line:
-            continue  # declared forwarding wrapper (re-exposes emit())
-        if not node.args:
-            out.append(f"{where}: emit() without an event argument")
-            continue
-        ev = node.args[0]
-        if not (isinstance(ev, ast.Constant) and isinstance(ev.value, str)):
-            out.append(f"{where}: event name must be a literal string "
-                       "(static checkability)")
-            continue
-        required = schema.get(ev.value)
-        if required is None:
-            out.append(f"{where}: undeclared event {ev.value!r} "
-                       f"(EVENT_SCHEMA: {sorted(schema)})")
-            continue
-        kw = {k.arg for k in node.keywords if k.arg is not None}
-        missing = [f for f in required if f not in kw]
-        if missing:
-            out.append(f"{where}: event {ev.value!r} missing required "
-                       f"keyword(s) {missing}")
-    return out
+    sups, _ = parse_suppressions(src)
+    suppressed = {s.line for s in sups if "DL006" in s.rules}
+    return [f"{f.path}:{f.line}: {f.message}"
+            for f in check_emit_calls(ctx, schema)
+            if f.line not in suppressed]
 
 
 def check_tree(root: str = ROOT) -> list:
-    schema = load_schema(root)
-    violations = []
-    targets = []
-    for d in CHECKED:
-        for dirpath, _, files in os.walk(os.path.join(root, d)):
-            targets += [os.path.join(dirpath, f) for f in files
-                        if f.endswith(".py")]
-    targets += [os.path.join(root, f) for f in CHECKED_FILES]
-    for path in sorted(targets):
-        if not os.path.exists(path):
-            continue
-        rel = os.path.relpath(path, root)
-        violations += check_file(path, schema, rel)
-    return violations
+    """The historical sweep, now one distlint invocation (DL006 only;
+    distlint's walker skips fixture dirs, where deliberately bad emit
+    calls live as linter test data)."""
+    paths = [d for d in CHECKED if os.path.isdir(os.path.join(root, d))]
+    paths += [f for f in CHECKED_FILES
+              if os.path.exists(os.path.join(root, f))]
+    result = lint_files(paths, root=root, select=["DL006"])
+    return [f"{f.path}:{f.line}: {f.message}" for f in result.findings]
 
 
 def main(argv=None) -> int:

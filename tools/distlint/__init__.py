@@ -1,0 +1,35 @@
+"""distlint — AST-based SPMD-correctness linter for the tpu_dist tree.
+
+Stdlib-only (ast + tokenize, no jax import): statically catches the
+distributed failure classes the runtime watchdog can only report after
+they hang a pod — collectives under host-divergent guards, blocking host
+syncs in the engines' hot loops, typo'd mesh axis names, untraced side
+effects inside jitted code, PRNG key reuse, and ledger schema drift.
+
+CLI::
+
+    python -m tools.distlint tpu_dist tools bench.py
+    python -m tools.distlint --json --select DL002,DL004 tpu_dist
+
+API::
+
+    from tools.distlint import lint_files
+    result = lint_files(["tpu_dist", "tools", "bench.py"])
+    assert result.findings == []
+
+Suppressions are inline, with a REQUIRED reason::
+
+    rows = np.asarray(x)  # distlint: disable=DL002 -- host array, not device
+
+See tools/distlint/rules.py for the rule catalog and README.md
+("Static analysis") for the rule table.
+"""
+
+from tools.distlint.core import (Finding, LintResult, Project, REPO_ROOT,
+                                 lint_files, load_event_schema,
+                                 load_mesh_axes, parse_suppressions)
+from tools.distlint.rules import RULES, RULES_BY_ID
+
+__all__ = ["Finding", "LintResult", "Project", "REPO_ROOT", "RULES",
+           "RULES_BY_ID", "lint_files", "load_event_schema",
+           "load_mesh_axes", "parse_suppressions"]

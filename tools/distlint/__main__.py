@@ -1,0 +1,72 @@
+"""CLI: ``python -m tools.distlint [paths...]``.
+
+Exits non-zero when any unsuppressed finding exists — wire it into CI
+(scripts/lint.sh) and the tree stays pinned at zero. The default path set
+is the acceptance surface: tpu_dist, tools, bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.distlint.core import REPO_ROOT, lint_files
+from tools.distlint.rules import RULES
+
+DEFAULT_PATHS = ["tpu_dist", "tools", "bench.py"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.distlint",
+        description="AST-based SPMD-correctness linter (stdlib-only).")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="repo root (mesh axes / ledger schema are loaded "
+                         "relative to it)")
+    ap.add_argument("--select", default=None, metavar="DL001,DL002",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output (findings + suppressed)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r.id}  {r.title}\n       {r.rationale}")
+        return 0
+
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    if select:
+        known = {r.id for r in RULES}
+        bad = sorted(set(select) - known)
+        if bad:
+            print(f"distlint: unknown rule id(s) {bad} "
+                  f"(known: {sorted(known)})", file=sys.stderr)
+            return 2
+    try:
+        result = lint_files(args.paths or DEFAULT_PATHS, root=args.root,
+                            select=select)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(f.render())
+        print(f"distlint: {len(result.findings)} finding(s), "
+              f"{len(result.suppressed)} suppressed, "
+              f"{result.files_checked} file(s) checked")
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:   # `... | head` closed the pipe: not an error
+        raise SystemExit(0)

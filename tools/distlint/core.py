@@ -1,0 +1,363 @@
+"""distlint engine: file walking, suppressions, rule running, output.
+
+Stdlib-only by contract (``ast`` + ``tokenize``; no jax import anywhere in
+the package): the linter must run in CI containers and pre-commit hooks
+that have no accelerator stack, and importing the checked modules would
+initialize a backend. Everything the rules need from the repo (mesh axis
+names, the ledger event schema) is extracted from SOURCE by AST — the same
+trick ``tools/check_ledger_schema.py`` proved out, generalized.
+
+Vocabulary:
+
+* a :class:`Finding` is one violation at ``path:line:col`` with a rule id;
+* a suppression is an inline comment ``# distlint: disable=DL002 -- reason``
+  (trailing on the flagged line, or standalone on the line above). The
+  reason is REQUIRED — a bare disable is itself a finding (DL000), because
+  an unexplained suppression is indistinguishable from a stale one;
+* :class:`Project` lazily loads cross-file facts (mesh axes, event schema)
+  relative to the repo root, so rules stay pure functions of (file, facts).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+MESH_FILE = os.path.join("tpu_dist", "parallel", "mesh.py")
+SCHEMA_FILE = os.path.join("tpu_dist", "obs", "ledger.py")
+
+# directory names never entered by the walker (explicit file arguments are
+# always linted — that is how the test fixtures get checked without the
+# clean-tree sweep tripping over their deliberately bad code)
+SKIP_DIRS = {"__pycache__", ".git", "fixtures", "node_modules", ".venv"}
+
+# meta-rule id: malformed suppressions, unparseable files. Not suppressible.
+META_RULE = "DL000"
+
+_SUPPRESS_RE = re.compile(
+    r"^#\s*distlint:\s*disable=(?P<rules>DL\d{3}(?:\s*,\s*DL\d{3})*)"
+    r"(?:\s*--\s*(?P<reason>.*\S))?\s*$")
+# directive recognition is anchored: only comments STARTING with
+# '# distlint:' are directives, so prose mentioning the tool stays inert
+_SUPPRESS_HINT_RE = re.compile(r"^#\s*distlint\s*:")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+    rule: str
+    path: str       # repo-relative, '/'-separated
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+
+@dataclass(frozen=True)
+class Suppression:
+    """A parsed ``# distlint: disable=...`` comment bound to a code line."""
+    rules: Tuple[str, ...]
+    reason: str
+    line: int        # the code line it applies to
+    comment_line: int
+
+    def to_json(self) -> dict:
+        return {"rules": list(self.rules), "reason": self.reason,
+                "line": self.line, "comment_line": self.comment_line}
+
+
+class FileContext:
+    """Per-file parse products shared by every rule (one AST, one token
+    pass per file — rules never re-read the source)."""
+
+    def __init__(self, path: str, rel: str, src: str):
+        self.path = path
+        self.rel = rel
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src)            # may raise SyntaxError
+        self.import_aliases = _import_aliases(self.tree)
+
+    def line_text(self, lineno: int) -> str:
+        return self.lines[lineno - 1] if 0 < lineno <= len(self.lines) else ""
+
+    def resolve(self, qualname: str) -> str:
+        """Expand the leading import alias of a dotted name:
+        ``np.random.seed`` -> ``numpy.random.seed`` under ``import numpy
+        as np``; ``random.split`` -> ``jax.random.split`` under ``from jax
+        import random``. Unknown heads pass through unchanged."""
+        if not qualname:
+            return qualname
+        head, sep, rest = qualname.partition(".")
+        target = self.import_aliases.get(head)
+        if target is None:
+            return qualname
+        return target + sep + rest if sep else target
+
+
+def _import_aliases(tree: ast.AST) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.partition(".")[0]] = (
+                    a.name if a.asname else a.name.partition(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+# --------------------------------------------------------------- project
+class Project:
+    """Cross-file facts, loaded lazily from source by AST (never imported)."""
+
+    def __init__(self, root: str = REPO_ROOT):
+        self.root = os.path.abspath(root)
+        self._mesh_axes: Optional[Set[str]] = None
+        self._event_schema: Optional[Dict[str, tuple]] = None
+
+    @property
+    def mesh_axes(self) -> Set[str]:
+        """Axis-name literals declared as ``*_AXIS = "..."`` in
+        tpu_dist/parallel/mesh.py — THE authority DL003 validates against.
+        Empty set (file absent) disables DL003 rather than flagging
+        everything."""
+        if self._mesh_axes is None:
+            self._mesh_axes = load_mesh_axes(self.root)
+        return self._mesh_axes
+
+    @property
+    def event_schema(self) -> Dict[str, tuple]:
+        if self._event_schema is None:
+            self._event_schema = load_event_schema(self.root)
+        return self._event_schema
+
+
+def load_mesh_axes(root: str = REPO_ROOT) -> Set[str]:
+    path = os.path.join(root, MESH_FILE)
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    axes: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Name) and t.id.endswith("_AXIS")
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    axes.add(node.value.value)
+    return axes
+
+
+def load_event_schema(root: str = REPO_ROOT) -> Dict[str, tuple]:
+    """EVENT_SCHEMA extracted from ledger.py source — a pure literal by
+    that dict's own contract."""
+    path = os.path.join(root, SCHEMA_FILE)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "EVENT_SCHEMA":
+                    return ast.literal_eval(node.value)
+    raise AssertionError(f"EVENT_SCHEMA literal not found in {path}")
+
+
+# ---------------------------------------------------------- suppressions
+def parse_suppressions(src: str) -> Tuple[List[Suppression], List[Tuple[int, str]]]:
+    """(suppressions, malformed) from the token stream.
+
+    A trailing comment suppresses its own line; a standalone comment (the
+    line holds nothing else) suppresses the next non-blank, non-comment
+    line. ``malformed`` is (line, problem) pairs for distlint comments that
+    fail the grammar — most importantly a missing ``-- reason``.
+    """
+    sups: List[Suppression] = []
+    malformed: List[Tuple[int, str]] = []
+    lines = src.splitlines()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return sups, malformed
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        text = tok.string
+        if not _SUPPRESS_HINT_RE.search(text):
+            continue
+        lineno = tok.start[0]
+        m = _SUPPRESS_RE.search(text)
+        if "disable" not in text:
+            # other distlint directives don't exist (yet): flag typos like
+            # '# distlint: off' instead of silently ignoring them
+            malformed.append((lineno, f"unrecognized distlint directive "
+                                      f"{text.strip()!r} (only "
+                                      "'disable=DLxxx -- reason' exists)"))
+            continue
+        if m is None or not (m.group("reason") or "").strip():
+            malformed.append(
+                (lineno, "suppression must carry a reason: "
+                         "'# distlint: disable=DLxxx -- <why this is ok>'"))
+            continue
+        rules = tuple(r.strip() for r in m.group("rules").split(","))
+        before = lines[lineno - 1][:tok.start[1]]
+        if before.strip():
+            target = lineno                       # trailing comment
+        else:                                     # standalone: next code line
+            target = lineno
+            for j in range(lineno + 1, len(lines) + 1):
+                s = lines[j - 1].strip()
+                if s and not s.startswith("#"):
+                    target = j
+                    break
+        sups.append(Suppression(rules=rules, reason=m.group("reason").strip(),
+                                line=target, comment_line=lineno))
+    return sups, malformed
+
+
+# --------------------------------------------------------------- linting
+@dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: List[Tuple[Finding, Suppression]]
+    files_checked: int
+
+    def to_json(self) -> dict:
+        return {
+            "findings": [f.to_json() for f in self.findings],
+            "suppressed": [dict(f.to_json(), reason=s.reason)
+                           for f, s in self.suppressed],
+            "files_checked": self.files_checked,
+        }
+
+
+def iter_python_files(paths: Sequence[str], root: str) -> List[str]:
+    """Expand dirs (recursively, skipping SKIP_DIRS) and keep explicit .py
+    file arguments as-is. Paths may be absolute or root-relative."""
+    out: List[str] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            out.append(full)
+        elif os.path.isdir(full):
+            for dirpath, dirnames, files in os.walk(full):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in SKIP_DIRS)
+                out += [os.path.join(dirpath, f) for f in sorted(files)
+                        if f.endswith(".py")]
+        else:
+            raise FileNotFoundError(f"distlint: no such path: {p}")
+    seen, uniq = set(), []
+    for f in out:
+        if f not in seen:
+            seen.add(f)
+            uniq.append(f)
+    return uniq
+
+
+def lint_files(paths: Sequence[str], root: str = REPO_ROOT,
+               select: Optional[Iterable[str]] = None,
+               project: Optional[Project] = None) -> LintResult:
+    """Run the (selected) rules over every file under ``paths``."""
+    from tools.distlint.rules import RULES
+
+    project = project or Project(root)
+    selected = [r for r in RULES
+                if select is None or r.id in set(select)]
+    findings: List[Finding] = []
+    suppressed: List[Tuple[Finding, Suppression]] = []
+    files = iter_python_files(paths, project.root)
+    for path in files:
+        rel = os.path.relpath(path, project.root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        sups, malformed = parse_suppressions(src)
+        for line, problem in malformed:
+            findings.append(Finding(META_RULE, rel, line, 0, problem))
+        try:
+            ctx = FileContext(path, rel, src)
+        except SyntaxError as e:
+            findings.append(Finding(META_RULE, rel, e.lineno or 0, 0,
+                                    f"unparseable: {e.msg}"))
+            continue
+        by_line: Dict[int, List[Suppression]] = {}
+        for s in sups:
+            # a suppression bound to ANY physical line of a multi-line
+            # statement covers the whole statement: findings anchor to the
+            # node's first line, while a trailing comment (or a formatter
+            # re-wrap) may sit on a continuation line
+            for line in _statement_span(ctx.tree, s.line):
+                by_line.setdefault(line, []).append(s)
+        for rule in selected:
+            for f in rule.check(ctx, project):
+                hit = next((s for s in by_line.get(f.line, ())
+                            if f.rule in s.rules), None)
+                if hit is not None:
+                    suppressed.append((f, hit))
+                else:
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(findings, suppressed, len(files))
+
+
+def _statement_span(tree: ast.AST, line: int) -> range:
+    """Physical-line range of the innermost SIMPLE statement containing
+    ``line`` (compound statements — defs, ifs, loops — are skipped: a
+    suppression inside one must not blanket its whole body). Falls back to
+    the single line itself."""
+    best = None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt) or isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                       ast.If, ast.For, ast.AsyncFor, ast.While, ast.With,
+                       ast.AsyncWith, ast.Try)):
+            continue
+        end = getattr(node, "end_lineno", node.lineno)
+        if node.lineno <= line <= end and (
+                best is None or node.lineno > best[0]):
+            best = (node.lineno, end)
+    if best is None:
+        return range(line, line + 1)
+    return range(best[0], best[1] + 1)
+
+
+# ----------------------------------------------------------- ast helpers
+def dotted_name(node: ast.AST) -> str:
+    """Dotted receiver chain: ``jax.random.split`` -> 'jax.random.split',
+    ``self.obs.ledger`` -> 'self.obs.ledger'. Non-name roots (calls,
+    subscripts) contribute an empty head: ``foo().bar`` -> '.bar'."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    parts.append(node.id if isinstance(node, ast.Name) else "")
+    return ".".join(reversed(parts))
+
+
+def terminal_name(node: ast.AST) -> str:
+    """The final component of a name/attribute chain ('' otherwise)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""

@@ -1,0 +1,614 @@
+"""The distlint rule set: SPMD-correctness hazards visible in source.
+
+Every rule is a pure function of (FileContext, Project) returning
+:class:`~tools.distlint.core.Finding` objects. The hazards are the failure
+classes the PR 2 watchdog can only report AFTER they hang a pod at runtime;
+GSPMD single-program multi-host JAX makes them statically visible:
+
+DL001  collectives/checkpoints reachable only under host-divergent guards
+       (``process_index() == 0``-style) — the other hosts never enter the
+       collective and the pod deadlocks.
+DL002  blocking host syncs inside the engines' hot step loops — each one
+       drains the async-dispatch queue and serializes the device.
+DL003  axis-name literals in PartitionSpec/collective calls validated
+       against the mesh axes declared in tpu_dist/parallel/mesh.py —
+       a typo'd axis only explodes at trace time, on hardware.
+DL004  untraced Python side effects (print/time.time/ledger emits) inside
+       jit/pjit/shard_map-traced functions — they fire once at trace time,
+       then never again, which is a lie in a log.
+DL005  PRNG hygiene: a key consumed twice (correlated draws), and global
+       numpy/stdlib RNG state (per-process divergence, irreproducibility).
+DL006  every ``*ledger*.emit(...)`` call site conforms to EVENT_SCHEMA
+       (the absorbed tools/check_ledger_schema check).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.distlint.core import (FileContext, Finding, Project, dotted_name,
+                                 terminal_name)
+
+
+class Rule:
+    id = "DL999"
+    title = ""
+    rationale = ""
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST, message: str) -> Finding:
+        return Finding(self.id, ctx.rel, getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0), message)
+
+
+def _calls(node: ast.AST) -> Iterable[ast.Call]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def _calls_same_scope(node: ast.AST) -> Iterable[ast.Call]:
+    """Calls that EXECUTE when ``node`` executes: nested function/lambda
+    bodies are pruned (they run at call time, not definition time)."""
+    stack = list(ast.iter_child_nodes(node))
+    if isinstance(node, ast.Call):
+        yield node
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _block_exits(stmts: Sequence[ast.stmt]) -> bool:
+    """Does this block unconditionally leave the enclosing code path?"""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+# ------------------------------------------------------------------ DL001
+class HostDivergentCollectives(Rule):
+    id = "DL001"
+    title = "collective under host-divergent guard"
+    rationale = ("a collective (or collective-entering call like "
+                 "save_checkpoint/assemble_global) that only a subset of "
+                 "processes reaches deadlocks the pod: the others wait in "
+                 "the next collective forever")
+
+    # call names that enter a cross-process collective (directly or, like
+    # save_checkpoint's sharded gather, conditionally inside)
+    COLLECTIVES = {
+        "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+        "ppermute", "pshuffle", "axis_index",
+        "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+        "assemble_global", "make_array_from_process_local_data",
+        "save_checkpoint", "barrier", "allreduce", "adasum_reduce",
+    }
+    _DIVERGENT_NAMES = {"is_main", "is_master", "is_primary", "main_process"}
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        self._scan(ctx.tree.body, False, ctx, out)
+        return out
+
+    def _divergent(self, test: ast.AST) -> bool:
+        for n in ast.walk(test):
+            if (isinstance(n, ast.Call)
+                    and terminal_name(n.func) == "process_index"):
+                return True
+            if (isinstance(n, (ast.Name, ast.Attribute))
+                    and terminal_name(n) in self._DIVERGENT_NAMES):
+                return True
+            if isinstance(n, ast.Compare):
+                # bare `rank` names only: `t.rank == 2` is a tensor-rank
+                # check, identical on every host, not a process guard
+                bare = {x.id for x in ast.walk(n) if isinstance(x, ast.Name)}
+                attrs = {terminal_name(x) for x in ast.walk(n)
+                         if isinstance(x, ast.Attribute)}
+                if "rank" in bare or "process_index" in bare | attrs:
+                    return True
+        return False
+
+    def _flag_collectives(self, node: ast.AST, ctx: FileContext,
+                          out: List[Finding], how: str) -> None:
+        # same-scope only: a function merely DEFINED under the guard may be
+        # called on every host — flagging its body would be a false alarm
+        for call in _calls_same_scope(node):
+            name = terminal_name(call.func)
+            if name in self.COLLECTIVES:
+                out.append(self.finding(
+                    ctx, call,
+                    f"collective call '{name}' is reachable only on a "
+                    f"subset of processes ({how}); the excluded hosts "
+                    "never enter it and the pod deadlocks at the next "
+                    "collective"))
+
+    def _scan(self, stmts: Sequence[ast.stmt], active: bool,
+              ctx: FileContext, out: List[Finding]) -> bool:
+        """Linear pass with an 'active' flag: after an early return taken
+        only on some processes, the REST of the block is host-divergent."""
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                # new runtime scope: divergence does not leak into a body
+                # that executes at call time, not definition time
+                body = s.body
+                self._scan(body, False, ctx, out)
+                continue
+            if active:
+                self._flag_collectives(s, ctx, out,
+                                       "code after a process_index-guarded "
+                                       "early return")
+                continue
+            if isinstance(s, ast.If) and self._divergent(s.test):
+                self._flag_collectives(
+                    s, ctx, out, "inside a process_index/is_main guard")
+                # 'if not main: return' makes everything AFTER main-only;
+                # symmetric for a guarded else-branch exit
+                if _block_exits(s.body) or (s.orelse
+                                            and _block_exits(s.orelse)):
+                    active = True
+                continue
+            # sub-blocks are scanned with the INCOMING flag (an If's orelse
+            # must not inherit divergence its sibling body introduced), but
+            # a guarded early return inside ANY of them makes the code
+            # after this statement divergent — propagate by OR-ing after
+            escaped = False
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(s, attr, None)
+                if sub:
+                    escaped = self._scan(sub, active, ctx, out) or escaped
+            for h in getattr(s, "handlers", ()):
+                escaped = self._scan(h.body, active, ctx, out) or escaped
+            active = active or escaped
+        return active
+
+
+# ------------------------------------------------------------------ DL002
+class HotLoopHostSync(Rule):
+    id = "DL002"
+    title = "blocking host sync in a hot step loop"
+    rationale = ("each .item()/device_get/np.asarray inside the step loop "
+                 "drains the async-dispatch queue, serializing host and "
+                 "device — the exact failure the drain-boundary design "
+                 "avoids")
+
+    # functions whose loops are the engines' hot paths (the decode tick is
+    # a lax.scan INSIDE jit — DL004's domain — so generate.py carries no
+    # Python-level hot loop to list here)
+    HOT_FUNC_RE = re.compile(
+        r"^(train_epoch|_train_epoch_windowed|_fit_epochs|validate)$")
+    BLOCKING_METHODS = {"item", "block_until_ready", "tolist"}
+    BLOCKING_QUALS = {"jax.device_get", "device_get", "numpy.asarray",
+                      "numpy.array", "jax.block_until_ready"}
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.FunctionDef)
+                    and self.HOT_FUNC_RE.match(node.name)):
+                for loop in self._loops(node):
+                    for stmt in loop.body + loop.orelse:
+                        self._scan_stmt(stmt, node.name, ctx, out)
+        return out
+
+    def _loops(self, fn: ast.FunctionDef):
+        """For/While nodes in fn, NOT descending into nested functions
+        (generators/closures run off the hot path — prefetch threads)."""
+        stack: List[ast.AST] = list(fn.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, (ast.For, ast.While)):
+                yield n
+                continue  # inner loops are reached via the body scan
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _scan_stmt(self, stmt: ast.stmt, fn_name: str, ctx: FileContext,
+                   out: List[Finding]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # off-loop execution (prefetch thread / deferred)
+        for child in ast.iter_child_nodes(stmt):
+            self._scan_stmt(child, fn_name, ctx, out)
+        if isinstance(stmt, ast.Call):
+            n = stmt
+            bad = None
+            tname = terminal_name(n.func)
+            qual = ctx.resolve(dotted_name(n.func))
+            if isinstance(n.func, ast.Attribute) \
+                    and tname in self.BLOCKING_METHODS:
+                bad = f".{tname}()"
+            elif qual in self.BLOCKING_QUALS:
+                bad = qual
+            elif (isinstance(n.func, ast.Name) and n.func.id in ("float", "int")
+                  and n.args
+                  and isinstance(n.args[0], (ast.Name, ast.Attribute))):
+                # float(x)/int(x) on a bare name is the classic implicit
+                # device->host sync; subscript/call args are usually reads
+                # of an already-fetched dict and stay silent
+                bad = f"{n.func.id}({dotted_name(n.args[0])})"
+            if bad:
+                out.append(self.finding(
+                    ctx, n,
+                    f"blocking host sync {bad!r} inside the hot loop of "
+                    f"{fn_name}() stalls async dispatch; queue device "
+                    "values and fetch them at a drain boundary instead"))
+
+
+# ------------------------------------------------------------------ DL003
+class UnknownMeshAxis(Rule):
+    id = "DL003"
+    title = "axis name not declared on the mesh"
+    rationale = ("a typo'd PartitionSpec axis ('modle') passes every CPU "
+                 "test and only explodes at trace time on the pod; the "
+                 "declared axes in parallel/mesh.py are the authority")
+
+    SPEC_CTORS = {"P", "PartitionSpec"}
+    AXIS_ARG_CALLS = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                      "all_to_all", "ppermute", "axis_index", "pbroadcast"}
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        axes = project.mesh_axes
+        if not axes:
+            return []
+        out: List[Finding] = []
+        for call in _calls(ctx.tree):
+            tname = terminal_name(call.func)
+            if tname in self.SPEC_CTORS:
+                for lit in self._axis_literals(list(call.args)
+                                               + [k.value for k in
+                                                  call.keywords]):
+                    self._validate(lit, axes, ctx, out, "PartitionSpec")
+            elif tname in self.AXIS_ARG_CALLS:
+                # axis_index(axis_name) takes the axis FIRST; the psum
+                # family takes (value, axis_name)
+                pos = 0 if tname == "axis_index" else 1
+                cands = list(call.args[pos:pos + 1]) + [
+                    k.value for k in call.keywords
+                    if k.arg in ("axis_name", "axis")]
+                for lit in self._axis_literals(cands):
+                    self._validate(lit, axes, ctx, out, f"{tname}()")
+        return out
+
+    def _axis_literals(self, nodes) -> Iterable[ast.Constant]:
+        for n in nodes:
+            if isinstance(n, (ast.Tuple, ast.List)):
+                yield from self._axis_literals(n.elts)
+            elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+                yield n
+
+    def _validate(self, lit: ast.Constant, axes: Set[str], ctx: FileContext,
+                  out: List[Finding], where: str) -> None:
+        if lit.value not in axes:
+            out.append(self.finding(
+                ctx, lit,
+                f"axis {lit.value!r} in {where} is not a mesh axis "
+                f"declared in tpu_dist/parallel/mesh.py "
+                f"({sorted(axes)}); a typo here fails only at trace "
+                "time on hardware"))
+
+
+# ------------------------------------------------------------------ DL004
+class TracedSideEffect(Rule):
+    id = "DL004"
+    title = "untraced Python side effect in jitted code"
+    rationale = ("print/time.time/ledger emits inside jit/shard_map bodies "
+                 "run ONCE at trace time and never again — a stale lie in "
+                 "the logs; use jax.debug.print/callback or hoist to the "
+                 "host loop")
+
+    SIDE_EFFECT_QUALS = {"time.time", "time.perf_counter", "time.monotonic",
+                         "time.sleep", "builtins.print"}
+    SIDE_EFFECT_NAMES = {"print", "input", "breakpoint"}
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        defs: Dict[str, List[ast.FunctionDef]] = {}
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.FunctionDef):
+                defs.setdefault(n.name, []).append(n)
+        traced: List[ast.FunctionDef] = []
+        seen: Set[int] = set()
+
+        def mark(name: str) -> None:
+            for fn in defs.get(name, ()):
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    traced.append(fn)
+
+        def mark_nested(name: str) -> None:
+            """jit(factory(...)): the TRACED code is whatever the factory
+            returns — its nested defs — while the factory's own body is
+            host-side build code that runs once and may print/time freely."""
+            for fn in defs.get(name, ()):
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.FunctionDef) and n is not fn \
+                            and id(n) not in seen:
+                        seen.add(id(n))
+                        traced.append(n)
+
+        for fn_list in defs.values():
+            for fn in fn_list:
+                if any(self._is_tracer(d, ctx) for d in fn.decorator_list):
+                    mark(fn.name)
+        for call in _calls(ctx.tree):
+            if not self._is_tracer_call(call, ctx) or not call.args:
+                continue
+            arg = call.args[0]
+            if isinstance(arg, ast.Name):
+                mark(arg.id)
+            elif isinstance(arg, ast.Call):
+                inner = arg
+                if terminal_name(inner.func) == "partial" and inner.args \
+                        and isinstance(inner.args[0], ast.Name):
+                    mark(inner.args[0].id)       # jit(partial(f, ...))
+                else:
+                    # factory pattern: jit(make_step(...)) traces the
+                    # function the factory RETURNS — its nested defs
+                    mark_nested(terminal_name(inner.func))
+        out: List[Finding] = []
+        for fn in traced:
+            self._scan(fn, ctx, out)
+        return out
+
+    def _is_tracer(self, node: ast.AST, ctx: FileContext) -> bool:
+        """jit / pjit / *shard_map* as a name, attribute, partial(...) or
+        configured-call decorator."""
+        if isinstance(node, ast.Call):
+            if terminal_name(node.func) == "partial":
+                return any(self._is_tracer(a, ctx) for a in node.args[:1])
+            return self._is_tracer(node.func, ctx)
+        t = terminal_name(node)
+        return t in ("jit", "pjit") or "shard_map" in t
+
+    def _is_tracer_call(self, call: ast.Call, ctx: FileContext) -> bool:
+        t = terminal_name(call.func)
+        return t in ("jit", "pjit") or "shard_map" in t
+
+    def _scan(self, fn: ast.FunctionDef, ctx: FileContext,
+              out: List[Finding]) -> None:
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            qual = ctx.resolve(dotted_name(n.func))
+            if "debug" in qual or "callback" in qual:
+                continue  # jax.debug.print / io_callback: the traced-safe way
+            tname = terminal_name(n.func)
+            hit = None
+            if isinstance(n.func, ast.Name) \
+                    and n.func.id in self.SIDE_EFFECT_NAMES:
+                hit = n.func.id
+            elif qual in self.SIDE_EFFECT_QUALS:
+                hit = qual
+            elif tname == "emit" and _is_ledger_receiver(n.func):
+                hit = f"{dotted_name(n.func)}()"
+            if hit:
+                out.append(self.finding(
+                    ctx, n,
+                    f"untraced side effect {hit!r} inside the traced "
+                    f"function {fn.name}() runs once at trace time and "
+                    "never per step; use jax.debug.print/io_callback or "
+                    "hoist it to the host loop"))
+
+
+# ------------------------------------------------------------------ DL005
+class PrngHygiene(Rule):
+    id = "DL005"
+    title = "PRNG key reuse / global RNG state"
+    rationale = ("a key consumed twice yields correlated draws (silently "
+                 "wrong statistics); global numpy/stdlib RNG state "
+                 "diverges across processes and kills reproducibility — "
+                 "use seeded np.random.default_rng / jax.random.fold_in")
+
+    CONSUMERS = {"split", "normal", "uniform", "randint", "bernoulli",
+                 "categorical", "permutation", "choice", "bits", "gamma",
+                 "beta", "gumbel", "exponential", "laplace", "poisson",
+                 "truncated_normal", "rademacher", "orthogonal", "shuffle",
+                 "randint_like", "loggamma", "dirichlet", "multivariate_normal"}
+    NP_SAFE = {"default_rng", "RandomState", "Generator", "SeedSequence",
+               "get_state", "set_state", "bit_generator"}
+    STDLIB_SAFE = {"Random", "SystemRandom"}
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Call):
+                self._check_global_rng(n, ctx, out)
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_key_reuse(n, ctx, out)
+        return out
+
+    # -- global RNG state ------------------------------------------------
+    def _check_global_rng(self, call: ast.Call, ctx: FileContext,
+                          out: List[Finding]) -> None:
+        qual = ctx.resolve(dotted_name(call.func))
+        parts = qual.split(".")
+        if len(parts) >= 3 and parts[-3] == "numpy" and parts[-2] == "random":
+            if parts[-1] not in self.NP_SAFE:
+                out.append(self.finding(
+                    ctx, call,
+                    f"global numpy RNG call '{qual}' draws from hidden "
+                    "per-process state (seeding races, host divergence); "
+                    "use a seeded np.random.default_rng(seed) generator"))
+        elif len(parts) == 2 and parts[0] == "random":
+            # qual is RESOLVED through the import table, so `import random
+            # as rnd; rnd.randint` and `from random import randint` both
+            # land here; `from jax import random` resolves to jax.random.*
+            # (3 parts) and never does
+            if parts[-1] not in self.STDLIB_SAFE:
+                out.append(self.finding(
+                    ctx, call,
+                    f"stdlib global RNG call '{qual}' is process-local "
+                    "hidden state; use random.Random(seed) or jax.random"))
+
+    # -- jax key reuse ---------------------------------------------------
+    def _check_key_reuse(self, fn: ast.AST, ctx: FileContext,
+                         out: List[Finding]) -> None:
+        uses: Dict[str, List[Tuple[int, ast.Call, tuple]]] = {}
+        assigns: Dict[str, List[int]] = {}
+        branches: Dict[int, tuple] = {}
+        scope_nodes: List[ast.AST] = []
+
+        def walk(node: ast.AST, path: tuple) -> None:
+            for child_name, value in ast.iter_fields(node):
+                kids = value if isinstance(value, list) else [value]
+                for kid in kids:
+                    if not isinstance(kid, ast.AST):
+                        continue
+                    sub = path
+                    if isinstance(node, (ast.If, ast.Try)) \
+                            and child_name in ("body", "orelse", "handlers",
+                                               "finalbody"):
+                        sub = path + ((id(node), child_name),)
+                    if isinstance(kid, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef, ast.Lambda)) \
+                            and kid is not fn:
+                        continue   # nested scopes analyzed on their own
+                    branches[id(kid)] = sub
+                    scope_nodes.append(kid)
+                    walk(kid, sub)
+
+        branches[id(fn)] = ()
+        walk(fn, ())
+
+        for n in scope_nodes:
+            if isinstance(n, ast.Call):
+                qual = ctx.resolve(dotted_name(n.func))
+                parts = qual.split(".")
+                is_jax_rng = (len(parts) >= 3 and parts[-2] == "random"
+                              and parts[-3] not in ("numpy",)
+                              and parts[-1] in self.CONSUMERS)
+                if is_jax_rng and n.args \
+                        and isinstance(n.args[0], ast.Name):
+                    uses.setdefault(n.args[0].id, []).append(
+                        (n.lineno, n, branches.get(id(n), ())))
+            for tgt in self._assign_targets(n):
+                lineno = getattr(n, "lineno", None) or getattr(
+                    getattr(n, "optional_vars", None), "lineno", 0)
+                assigns.setdefault(tgt, []).append(lineno)
+
+        for name, consumptions in uses.items():
+            consumptions.sort(key=lambda u: u[0])
+            for (l1, _, b1), (l2, node2, b2) in zip(consumptions,
+                                                    consumptions[1:]):
+                if any(l1 <= a < l2 for a in assigns.get(name, ())):
+                    continue   # rebound between the two uses (rng, sub = ...)
+                if self._sibling_branches(b1, b2):
+                    continue   # if/else arms: only one executes
+                out.append(self.finding(
+                    ctx, node2,
+                    f"PRNG key '{name}' is consumed again (line {l1} "
+                    f"already passed it to jax.random) without a "
+                    "re-split; reusing a key yields correlated draws — "
+                    "split/fold_in first"))
+
+    @staticmethod
+    def _assign_targets(n: ast.AST) -> Iterable[str]:
+        targets: List[ast.AST] = []
+        if isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        elif isinstance(n, ast.For):
+            targets = [n.target]
+        elif isinstance(n, ast.NamedExpr):
+            targets = [n.target]
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            targets = [n.optional_vars]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                yield t.id
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    if isinstance(e, ast.Name):
+                        yield e.id
+
+    @staticmethod
+    def _sibling_branches(b1: tuple, b2: tuple) -> bool:
+        for (n1, lbl1), (n2, lbl2) in zip(b1, b2):
+            if n1 != n2:
+                return False
+            if lbl1 != lbl2:
+                return True
+        return False
+
+
+# ------------------------------------------------------------------ DL006
+FORWARD_MARK = "ledger-schema: forward"
+
+
+def _is_ledger_receiver(func: ast.AST) -> bool:
+    """Receiver of ``.emit`` looks like a ledger ('led' included so the
+    natural short name cannot dodge the checker)."""
+    if not isinstance(func, ast.Attribute):
+        return False
+    name = terminal_name(func.value).lower()
+    return "ledger" in name or name == "led"
+
+
+def check_emit_calls(ctx: FileContext, schema: Dict[str, tuple],
+                     rule_id: str = "DL006") -> List[Finding]:
+    """Every ``*ledger*.emit(...)`` call site names a declared event as a
+    literal and passes all its required fields as explicit keywords (the
+    former tools/check_ledger_schema.py walk, verbatim semantics —
+    including the ``# ledger-schema: forward`` escape for wrappers that
+    re-expose emit()'s own signature)."""
+    out: List[Finding] = []
+    for node in _calls(ctx.tree):
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "emit"
+                and _is_ledger_receiver(f)):
+            continue
+        if FORWARD_MARK in ctx.line_text(node.lineno):
+            continue
+        mk = lambda msg: Finding(rule_id, ctx.rel, node.lineno,
+                                 node.col_offset, msg)
+        if not node.args:
+            out.append(mk("emit() without an event argument"))
+            continue
+        ev = node.args[0]
+        if not (isinstance(ev, ast.Constant) and isinstance(ev.value, str)):
+            out.append(mk("event name must be a literal string "
+                          "(static checkability)"))
+            continue
+        required = schema.get(ev.value)
+        if required is None:
+            out.append(mk(f"undeclared event {ev.value!r} "
+                          f"(EVENT_SCHEMA: {sorted(schema)})"))
+            continue
+        kw = {k.arg for k in node.keywords if k.arg is not None}
+        missing = [x for x in required if x not in kw]
+        if missing:
+            out.append(mk(f"event {ev.value!r} missing required "
+                          f"keyword(s) {missing}"))
+    return out
+
+
+class LedgerSchema(Rule):
+    id = "DL006"
+    title = "ledger emit() schema conformance"
+    rationale = ("schema drift — a renamed field, an undeclared event — "
+                 "must fail at review time, not at 3am when someone greps "
+                 "a ledger")
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        schema = project.event_schema
+        if not schema:
+            return []
+        return check_emit_calls(ctx, schema, self.id)
+
+
+RULES: List[Rule] = [HostDivergentCollectives(), HotLoopHostSync(),
+                     UnknownMeshAxis(), TracedSideEffect(), PrngHygiene(),
+                     LedgerSchema()]
+
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in RULES}

@@ -591,7 +591,10 @@ class LMTrainer:
                     self.state, inputs_d, targets_d, self.rng)
             dispatch_s = tr.pop().get("dispatch", 0.0)
             if not self._warmed:
-                jax.device_get(metrics)  # compile + first step, to the wall
+                # compile + first step, to the wall — a deliberate one-time
+                # block so warm_secs excludes XLA compile from tok/s
+                # distlint: disable=DL002 -- intentional single sync on the run's first dispatch (compile-wall measurement)
+                jax.device_get(metrics)
                 self._warmed = True
                 warm_secs = time.time() - end
                 warm_batches = 1
@@ -685,7 +688,9 @@ class LMTrainer:
                     self.state, self._train_rows_dev, idx_dev, self.rng)
             dispatch_s = tr.pop().get("dispatch", 0.0)
             if not self._warmed:
-                jax.device_get(metrics)  # compile + first window, to the wall
+                # compile + first window, to the wall (see train_epoch)
+                # distlint: disable=DL002 -- intentional single sync on the run's first dispatch (compile-wall measurement)
+                jax.device_get(metrics)
                 self._warmed = True
                 warm_secs = time.time() - end
                 warm_batches = n
@@ -907,8 +912,9 @@ class LMTrainer:
                     is_best=is_best)
             # LR actually applied by the LAST update of this epoch (the
             # schedule is evaluated at the pre-increment step counter)
-            lr_now = float(np.asarray(self.lr_schedule(
-                max(int(np.asarray(jax.device_get(self.state.step))) - 1, 0))))
+            # distlint: disable=DL002 -- epoch boundary: validate() just drained the device queue, one scalar fetch is free
+            step_done = int(jax.device_get(self.state.step))
+            lr_now = float(self.lr_schedule(max(step_done - 1, 0)))
             self.log(
                 f"Epoch {epoch} [{self.mode}]: "
                 f"train_loss={train_metrics['loss']:.4f} "

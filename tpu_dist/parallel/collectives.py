@@ -150,6 +150,7 @@ def barrier(mesh: Mesh | None = None) -> None:
     m = Mesh(np.asarray(devices), ("all",))
     one = jax.device_put(
         jnp.zeros((len(devices),), jnp.int32),
+        # distlint: disable=DL003 -- 'all' names this function's own throwaway 1-axis mesh (built one line up), not the training mesh
         NamedSharding(m, P("all")))
     jnp.sum(one).block_until_ready()
 

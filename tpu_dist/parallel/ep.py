@@ -14,7 +14,7 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-EXPERT_AXIS = "expert"
+from tpu_dist.parallel.mesh import EXPERT_AXIS
 
 
 def _moe_leaf_spec(key: str, leaf, axis: str,

@@ -24,10 +24,16 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# THE axis-name authority: every mesh axis the framework can carry is
+# declared here (tools/distlint rule DL003 validates PartitionSpec/collective
+# axis literals across the tree against exactly this list, by AST — add an
+# axis here FIRST, or the linter rejects its uses)
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+STAGE_AXIS = "stage"    # pipeline parallel (parallel.pp)
+EXPERT_AXIS = "expert"  # MoE expert parallel (parallel.ep)
 
 
 def make_mesh(shape: Optional[Sequence[int]] = None,

@@ -44,9 +44,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_dist.engine.state import TrainState
 from tpu_dist.engine.steps import _apply_update
-from tpu_dist.parallel.mesh import DATA_AXIS
-
-STAGE_AXIS = "stage"
+from tpu_dist.parallel.mesh import DATA_AXIS, STAGE_AXIS
 
 
 def _uses_tp(mesh: Mesh, model_axis: str = "model") -> bool:
