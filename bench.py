@@ -103,7 +103,10 @@ def lm_geometry():
         k=int(os.environ.get("BENCH_STEPS_PER_WINDOW",
                              os.environ.get("BENCH_STEPS", "20"))),
         loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "0")),
-        quant=os.environ.get("BENCH_QUANT") or "none")
+        quant=os.environ.get("BENCH_QUANT") or "none",
+        tp_impl=os.environ.get("BENCH_TP_IMPL") or "gspmd",
+        tp=int(os.environ.get("BENCH_TP_DEGREE", "2")),
+        grad_bucket_mb=float(os.environ.get("BENCH_GRAD_BUCKET_MB", "0")))
 
 
 def lm_build():
@@ -130,6 +133,14 @@ def lm_build():
     loss_chunk = g["loss_chunk"]
     from tpu_dist.ops.quant import validate_quant
     quant = validate_quant(g["quant"])
+    from tpu_dist.parallel.overlap import validate_tp_impl
+    tp_impl = validate_tp_impl(g["tp_impl"])
+    grad_bucket_mb = g["grad_bucket_mb"]
+    if tp_impl == "ring" and grad_bucket_mb > 0:
+        raise SystemExit("BENCH_TP_IMPL=ring and BENCH_GRAD_BUCKET_MB are "
+                         "separate overlap paths (ring TP vs dp bucketed "
+                         "sync); set one per run so the headline is "
+                         "attributable")
 
     if attn_kind == "flash":
         from tpu_dist.ops.flash_attention import flash_attention_fn
@@ -139,7 +150,16 @@ def lm_build():
         attn_fn = blockwise_attention_fn(512)
     else:
         attn_fn = full_attention
-    mesh = make_mesh()
+    if tp_impl == "ring":
+        tp = g["tp"]
+        if n_chips % tp or heads % tp or L % tp:
+            raise SystemExit(
+                f"BENCH_TP_IMPL=ring needs BENCH_TP_DEGREE ({tp}) dividing "
+                f"the chip count ({n_chips}), BENCH_HEADS ({heads}) and "
+                f"BENCH_SEQ_LEN ({L})")
+        mesh = make_mesh((-1, tp), ("data", "model"))
+    else:
+        mesh = make_mesh()
     model = TransformerLM(
         vocab_size=vocab, num_layers=layers, d_model=d_model,
         num_heads=heads, max_len=L, dtype=jnp.bfloat16, attn_fn=attn_fn,
@@ -160,8 +180,25 @@ def lm_build():
         raise SystemExit(f"BENCH_OPTIMIZER={opt}: sgd|adamw|fused_adamw")
     state = jax.device_put(TrainState.create(params, {}, tx),
                            replicated(mesh))
-    window = make_lm_indexed_multi_train_step(model, tx, mesh,
-                                              loss_chunk=loss_chunk)
+    if tp_impl == "ring":
+        # ring collective-matmul TP (parallel.overlap): K-step windows scan
+        # inside the explicit shard_map program; params stay replicated
+        from tpu_dist.engine.lm_steps import (
+            _lm_tp_ring_step_fn, make_lm_explicit_indexed_multi_train_step)
+        ring_step = _lm_tp_ring_step_fn(
+            model.clone(tp_impl="ring"), tx, 0.01, "data", "model",
+            mesh.shape["model"], loss_chunk=loss_chunk)
+        window = make_lm_explicit_indexed_multi_train_step(ring_step, mesh)
+    elif grad_bucket_mb > 0:
+        from tpu_dist.engine.lm_steps import (
+            _lm_explicit_dp_step_fn, make_lm_explicit_indexed_multi_train_step)
+        dp_step = _lm_explicit_dp_step_fn(
+            model, tx, 0.01, "data", mesh.shape["data"], grad_bucket_mb,
+            loss_chunk=loss_chunk)
+        window = make_lm_explicit_indexed_multi_train_step(dp_step, mesh)
+    else:
+        window = make_lm_indexed_multi_train_step(model, tx, mesh,
+                                                  loss_chunk=loss_chunk)
 
     rng = np.random.default_rng(0)
     rows = rng.integers(0, vocab, (batch, L + 1)).astype(np.int32)
@@ -173,7 +210,8 @@ def lm_build():
                 idx_dev=idx_dev, key=key, params=params, mesh=mesh,
                 n_chips=n_chips, L=L, d_model=d_model, layers=layers,
                 batch=batch, k=k, attn_kind=attn_kind,
-                loss_chunk=loss_chunk, quant=quant)
+                loss_chunk=loss_chunk, quant=quant, tp_impl=tp_impl,
+                grad_bucket_mb=grad_bucket_mb)
 
 
 def lm_bench():
@@ -207,6 +245,7 @@ def lm_bench():
     n_chips, L, batch, k = b["n_chips"], b["L"], b["batch"], b["k"]
     layers, d_model = b["layers"], b["d_model"]
     attn_kind, loss_chunk, quant = b["attn_kind"], b["loss_chunk"], b["quant"]
+    tp_impl, grad_bucket_mb = b["tp_impl"], b["grad_bucket_mb"]
     trials = int(os.environ.get("BENCH_TRIALS", "3"))
 
     # analytical model FLOPs (tpu_dist.utils.mfu.lm_flops_per_token; XLA's
@@ -246,7 +285,8 @@ def lm_bench():
                         mfu=t_tf / effective_peak_tflops()[0],
                         steps_in_dispatch=k, data_s=0.0,
                         dispatch_s=phases[-1]["dispatch_s"],
-                        device_s=phases[-1]["device_s"])
+                        device_s=phases[-1]["device_s"],
+                        comm_s=None)
     best = max(rates)
     best_phases = phases[rates.index(best)]
     tok_chip = best / n_chips
@@ -260,6 +300,8 @@ def lm_bench():
           f"attn={attn_kind}"
           + (f" loss_chunk={loss_chunk}" if loss_chunk else "")
           + (f" quant={quant}" if quant != "none" else "")
+          + (f" tp_impl={tp_impl}" if tp_impl != "gspmd" else "")
+          + (f" grad_bucket_mb={grad_bucket_mb:g}" if grad_bucket_mb else "")
           + f": {tok_chip:,.0f} tok/s/chip, trials "
           f"{[round(r / n_chips) for r in rates]}"
           + (f", {tflops:.1f} TFLOP/s/chip" if tflops else "")
@@ -267,16 +309,25 @@ def lm_bench():
              "int8 MXU path doubles it)" if mfu and quant == "int8" else
              f", MFU {mfu * 100:.1f}% of {peak} TF peak" if mfu else ""),
           file=sys.stderr)
-    # BENCH_QUANT publishes its OWN metric name: the quantized variant rides
-    # alongside the bf16 headline, never replacing it (the headline's name —
-    # and its baseline comparison — must stay like-for-like bf16)
+    # BENCH_QUANT / BENCH_TP_IMPL publish their OWN metric names: variants
+    # ride alongside the bf16 GSPMD headline, never replacing it (the
+    # headline's name — and its baseline comparison — must stay
+    # like-for-like), and the config block pins tp_impl/grad_bucket_mb so
+    # two runs are never silently cross-compared
     quant_tag = f"_{quant}" if quant != "none" else ""
+    impl_tag = (f"_{tp_impl}" if tp_impl != "gspmd" else
+                "_bucketed" if grad_bucket_mb else "")
     print(json.dumps({
-        "metric": f"lm_{layers}l_d{d_model}_seq{L}{quant_tag}"
+        "metric": f"lm_{layers}l_d{d_model}_seq{L}{quant_tag}{impl_tag}"
                   "_tokens_per_sec_per_chip",
         "value": round(tok_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": 1.0,
+        "config": {"tp_impl": tp_impl, "grad_bucket_mb": grad_bucket_mb,
+                   "quant": quant, "attn": attn_kind,
+                   "loss_chunk": loss_chunk,
+                   "tp_degree": (b["mesh"].shape["model"]
+                                 if tp_impl == "ring" else 1)},
         "mfu": round(mfu, 4) if mfu else None,
         "tflops": round(tflops, 2) if tflops else None,
         "phases": best_phases,
@@ -381,6 +432,14 @@ def main():
             f"BENCH_QUANT={os.environ['BENCH_QUANT']} applies to the LM "
             f"bench only (BENCH_ARCH=transformer_lm); BENCH_ARCH={ARCH} "
             "has no quantized path")
+    if os.environ.get("BENCH_TP_IMPL", "gspmd") not in ("", "gspmd") \
+            or float(os.environ.get("BENCH_GRAD_BUCKET_MB", "0") or 0) > 0:
+        # same guard pattern: the overlap knobs drive the LM bench; the
+        # image bench's jit window has no explicit collectives to decompose
+        raise SystemExit(
+            "BENCH_TP_IMPL/BENCH_GRAD_BUCKET_MB apply to the LM bench only "
+            f"(BENCH_ARCH=transformer_lm); BENCH_ARCH={ARCH} rides the "
+            "compiler-scheduled path")
 
     n_chips = jax.device_count()
     per_chip_batch = int(os.environ.get("BENCH_PER_CHIP_BATCH", "1024"))
@@ -488,7 +547,7 @@ def main():
                         mfu=round(tf / eff_peak, 6) if tf else None,
                         steps_in_dispatch=k, data_s=0.0,
                         dispatch_s=ph["dispatch_s"],
-                        device_s=ph["device_s"])
+                        device_s=ph["device_s"], comm_s=None)
         ledger.emit("run_end", steps=trials * k,
                     seconds=round(sum(batch * k / r for r in rates), 3))
         ledger.close()

@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""Communication microbenchmark: ring vs GSPMD collective matmul, and
+bucketed vs monolithic gradient sync, swept over sizes.
+
+    JAX_PLATFORMS=cpu python tools/comm_bench.py --cpu-devices 8
+    python tools/comm_bench.py --dims 2048,1024,4096 --iters 20   # on TPU
+    python tools/comm_bench.py --ledger comm.jsonl                # + records
+
+Three per-size tables (stdlib + jax only):
+
+1. ``allreduce``  — parallel.collectives.ring_allreduce (the chunked
+   ppermute two-pass ring) vs XLA's fused ``psum`` of the same buffer;
+2. ``matmul``     — the Megatron column+row projection pair as the ring
+   collective matmul (parallel.overlap: AG-matmul + matmul-RS inside
+   shard_map) vs the GSPMD einsum pair (sharded weights, XLA-inserted
+   collectives), outputs verified allclose per geometry;
+3. ``grad sync``  — parallel.overlap.bucketed_grad_sync (independent
+   ~bucket-MB reduce-scatter+all-gather collectives, DDP's decomposition)
+   vs the monolithic per-leaf psum the engines used through round 7.
+
+``--ledger`` appends obs.ledger ``step`` records whose ``comm_s`` is the
+MEASURED per-dispatch seconds (these programs are pure communication, so
+device time == comm time — the one place the ledger's comm phase is exact
+rather than a probe estimate); query with tools/ledger_report.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sizes-mb", type=float, nargs="+",
+                    default=[0.25, 4.0, 32.0],
+                    help="buffer sizes for the allreduce + grad-sync sweeps")
+    ap.add_argument("--dims", type=str, nargs="+",
+                    default=["256,256,1024", "512,512,2048", "512,1024,4096"],
+                    help="L,D,F collective-matmul geometries (batch fixed 4)")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--bucket-mb", type=float, default=25.0,
+                    help="bucket target for the grad-sync sweep (DDP ~25)")
+    ap.add_argument("--ledger", type=str, default="",
+                    help="append obs.ledger step records here")
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="force the CPU backend with N virtual devices "
+                    "(no-op if the backend is already initialized)")
+    return ap.parse_args(argv)
+
+
+def _timeit(fn, args, iters: int) -> float:
+    import jax
+
+    jax.block_until_ready(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _row(label: str, a: str, b: str, ta: float, tb: float) -> str:
+    ratio = ta / tb if tb else float("inf")
+    return (f"  {label:<24} {a:>10}: {ta * 1e3:9.3f} ms   "
+            f"{b:>10}: {tb * 1e3:9.3f} ms   {a}/{b} = {ratio:5.2f}x")
+
+
+def bench_allreduce(mesh, sizes_mb, iters, emit):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from tpu_dist._compat import shard_map
+    from tpu_dist.parallel.collectives import ring_allreduce
+    from tpu_dist.parallel.mesh import DATA_AXIS
+
+    n = mesh.devices.size
+    print(f"\nallreduce (sum across {n} devices, per-device buffer):")
+    for mb in sizes_mb:
+        elems = max(n, int(mb * 1e6 / 4))
+        x = jnp.ones((elems,), jnp.float32)
+
+        def ring(v):
+            return ring_allreduce(v, DATA_AXIS, n)
+
+        def fused(v):
+            return jax.lax.psum(v, DATA_AXIS)
+
+        wrap = lambda f: jax.jit(shard_map(
+            f, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
+        t_ring = _timeit(wrap(ring), (x,), iters)
+        t_psum = _timeit(wrap(fused), (x,), iters)
+        print(_row(f"{mb:g} MB", "ring", "psum", t_ring, t_psum))
+        emit(f"allreduce_{mb:g}mb", t_ring, elems * 4)
+
+
+def bench_collective_matmul(mesh, dims, iters, emit):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tpu_dist._compat import shard_map
+    from tpu_dist.parallel.mesh import MODEL_AXIS
+    from tpu_dist.parallel.overlap import (ring_allgather_matmul,
+                                           ring_matmul_reduce_scatter)
+
+    n = mesh.devices.size
+    b = 4
+    print(f"\ncollective matmul (column+row Megatron pair over {n} shards, "
+          f"batch {b}):")
+    for spec in dims:
+        L, D, F = (int(v) for v in spec.split(","))
+        if L % n or F % n or D % n:
+            print(f"  {spec}: skipped (dims must divide the axis size {n})")
+            continue
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(b, L, D)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(D, F)) * 0.05, jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(F, D)) * 0.05, jnp.float32)
+
+        def pair_ring(xs, a, c):
+            h = ring_allgather_matmul(xs, a, MODEL_AXIS)
+            return ring_matmul_reduce_scatter(h, c, MODEL_AXIS)
+
+        ring = jax.jit(shard_map(
+            pair_ring, mesh=mesh,
+            in_specs=(P(None, MODEL_AXIS, None), P(None, MODEL_AXIS),
+                      P(MODEL_AXIS, None)),
+            out_specs=P(None, MODEL_AXIS, None), check_vma=False))
+
+        gspmd = jax.jit(
+            lambda xs, a, c: (xs @ a) @ c,
+            in_shardings=(NamedSharding(mesh, P(None, MODEL_AXIS, None)),
+                          NamedSharding(mesh, P(None, MODEL_AXIS)),
+                          NamedSharding(mesh, P(MODEL_AXIS, None))),
+            out_shardings=NamedSharding(mesh, P(None, MODEL_AXIS, None)))
+
+        np.testing.assert_allclose(np.asarray(ring(x, w1, w2)),
+                                   np.asarray(gspmd(x, w1, w2)),
+                                   rtol=2e-4, atol=2e-4)
+        t_ring = _timeit(ring, (x, w1, w2), iters)
+        t_gspmd = _timeit(gspmd, (x, w1, w2), iters)
+        print(_row(f"L{L} D{D} F{F}", "ring", "gspmd", t_ring, t_gspmd))
+        emit(f"matmul_L{L}_D{D}_F{F}", t_ring, b * L * D * 4)
+
+
+def bench_grad_sync(mesh, sizes_mb, bucket_mb, iters, emit):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from tpu_dist._compat import shard_map
+    from tpu_dist.parallel.mesh import DATA_AXIS
+    from tpu_dist.parallel.overlap import bucketed_grad_sync
+
+    n = mesh.devices.size
+    print(f"\ngradient sync across {n} replicas "
+          f"(bucketed @ {bucket_mb:g} MB vs monolithic psum):")
+    for mb in sizes_mb:
+        elems = max(n, int(mb * 1e6 / 4))
+        # a realistic ragged tree: a big embedding-ish leaf + smaller ones
+        tree = {"emb": jnp.ones((elems // 2,), jnp.float32),
+                "w1": jnp.ones((elems // 4,), jnp.float32),
+                "w2": jnp.ones((elems // 8,), jnp.float32),
+                "rest": jnp.ones((elems - elems // 2 - elems // 4
+                                  - elems // 8,), jnp.float32)}
+
+        def bucketed(t):
+            return bucketed_grad_sync(t, DATA_AXIS, bucket_mb, mean=True,
+                                      axis_size=n)
+
+        def monolithic(t):
+            return jax.tree.map(lambda g: jax.lax.pmean(g, DATA_AXIS), t)
+
+        wrap = lambda f: jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False))
+        t_b = _timeit(wrap(bucketed), (tree,), iters)
+        t_m = _timeit(wrap(monolithic), (tree,), iters)
+        print(_row(f"{mb:g} MB tree", "bucketed", "monolithic", t_b, t_m))
+        emit(f"grad_sync_{mb:g}mb", t_b, elems * 4)
+
+
+def main(argv=None) -> int:
+    args = _args(argv)
+    if args.cpu_devices:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            from tpu_dist._compat import set_cpu_device_count
+            set_cpu_device_count(args.cpu_devices)
+        except Exception as e:  # backend already live (e.g. under pytest)
+            print(f"--cpu-devices: backend already initialized ({e}); "
+                  "using the existing devices", file=sys.stderr)
+    import jax
+    from tpu_dist.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+    n = jax.device_count()
+    if n < 2:
+        print(f"comm_bench needs >= 2 devices (have {n}); run with "
+              "JAX_PLATFORMS=cpu --cpu-devices 8", file=sys.stderr)
+        return 1
+    data_mesh = make_mesh((n,), (DATA_AXIS,))
+    model_mesh = make_mesh((n,), (MODEL_AXIS,))
+    print(f"devices: {n} x {jax.devices()[0].device_kind}")
+
+    ledger = None
+    step_i = 0
+    if args.ledger:
+        from tpu_dist.obs import Ledger
+
+        ledger = Ledger(args.ledger)
+        ledger.emit("run_start", kind="comm_bench",
+                    config={"sizes_mb": args.sizes_mb, "dims": args.dims,
+                            "bucket_mb": args.bucket_mb,
+                            "iters": args.iters},
+                    mesh={"data": n}, process_count=jax.process_count(),
+                    devices=sorted({d.device_kind for d in
+                                    jax.local_devices()}))
+
+    def emit(label, seconds, nbytes):
+        nonlocal step_i
+        if ledger is None:
+            return
+        # pure-communication programs: device time IS comm time, so the
+        # comm phase here is measured, not estimated
+        ledger.emit("step", step=step_i, loss=None,
+                    throughput=round(nbytes / seconds / 1e9, 3),
+                    unit="GB/s", data_s=0.0, dispatch_s=0.0,
+                    device_s=round(seconds, 6), comm_s=round(seconds, 6),
+                    mfu=None, label=label)
+        step_i += 1
+
+    t0 = time.perf_counter()
+    bench_allreduce(data_mesh, args.sizes_mb, args.iters, emit)
+    bench_collective_matmul(model_mesh, args.dims, args.iters, emit)
+    bench_grad_sync(data_mesh, args.sizes_mb, args.bucket_mb, args.iters,
+                    emit)
+    if ledger is not None:
+        ledger.emit("run_end", steps=step_i,
+                    seconds=round(time.perf_counter() - t0, 3))
+        ledger.close()
+        print(f"\nledger: {args.ledger}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -72,7 +72,9 @@ def summarize(records, out=print):
         warm_n = sum(1 for r in steps if r.get("warm"))
         hot = [r for r in steps if not r.get("warm")] or steps
         tot = phase_totals(hot)
-        total = sum(tot.values()) or 1.0
+        # comm_s OVERLAPS device_s (obs.ledger schema note): it reports
+        # beside the share table, never inside its denominator
+        total = tot["data_s"] + tot["dispatch_s"] + tot["device_s"] or 1.0
         out(f"\nsteps: {sum(r.get('steps_in_dispatch') or 1 for r in steps)} "
             f"optimizer steps in {len(steps)} records"
             + (f" ({warm_n} warm/compile record(s) excluded from shares)"
@@ -81,6 +83,12 @@ def summarize(records, out=print):
         for k, label in (("data_s", "data wait"), ("dispatch_s", "dispatch"),
                          ("device_s", "device block")):
             out(f"  {label:<13} {tot[k]:9.3f}s  {tot[k] / total * 100:5.1f}%")
+        if tot.get("comm_s"):
+            dev = tot["device_s"] or 1e-9
+            out(f"  comm          {tot['comm_s']:9.3f}s  "
+                f"{tot['comm_s'] / dev * 100:5.1f}% of the device block "
+                "(unoverlapped-cost estimate; overlap shows as device_s "
+                "growing LESS than comm_s when buckets/rings land)")
         tp = [r["throughput"] for r in hot if r["throughput"] is not None]
         mfu = [r["mfu"] for r in hot if r["mfu"] is not None]
         a, b, c = _thirds(tp)

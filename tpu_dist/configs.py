@@ -78,6 +78,19 @@ class TrainConfig:
     grad_compression: str = "none"     # none | bf16  (hvd.Compression.fp16-equiv,
                                        # reference 5.horovod_distributed.py:123-125)
 
+    # -- comm/compute overlap (parallel.overlap; no reference analog beyond
+    #    DDP's own bucket overlap, which grad_bucket_mb reproduces)
+    tp_impl: str = "gspmd"             # gspmd | ring: ring = manual
+                                       # collective-matmul TP for the
+                                       # transformer-family archs (vit_*)
+                                       # under variant='shard_map' with a
+                                       # 'model' mesh axis
+    grad_bucket_mb: float = 0.0        # >0: explicit grad sync in DDP-style
+                                       # size-targeted bucket collectives
+                                       # (~25 is DDP's default) instead of
+                                       # one fused allreduce; requires
+                                       # variant='shard_map'
+
     # -- distribution (reference C5/C6/C25 + TPU mesh)
     variant: str = "jit"               # engine flavor tag for logging only
     mesh_shape: Optional[Sequence[int]] = None  # e.g. (8,) dp; (4,2) dp x model
@@ -218,6 +231,18 @@ class LMConfig:
     #    expert / stage — see scripts/8)
     mesh_shape: Optional[Sequence[int]] = None
     mesh_axes: Sequence[str] = ("data",)
+    tp_impl: str = "gspmd"         # gspmd (declarative Megatron specs,
+                                   # parallel.tp) | ring (manual collective
+                                   # matmul with comm/compute overlap,
+                                   # parallel.overlap) — picks HOW a
+                                   # 'model' mesh axis is implemented;
+                                   # identical param trees/checkpoints,
+                                   # fp losses allclose (tests)
+    grad_bucket_mb: float = 0.0    # >0: dp grad sync as DDP-style bucket
+                                   # reduce-scatter collectives of ~this
+                                   # many MB (25 = DDP's default) instead
+                                   # of one fused tree-wide allreduce
+                                   # (engine.lm_steps explicit dp step)
     fsdp: bool = False             # ZeRO-3 param+opt sharding over 'data'
     pp_microbatches: int = 4       # pipeline microbatches (with a 'stage' axis)
     pp_schedule: str = "gpipe"     # gpipe (autodiff through the tick scan;

@@ -82,6 +82,10 @@ class LMTrainer:
         self.use_tp = "model" in names and shape["model"] > 1
         self.use_ep = "expert" in names and shape["expert"] > 1
         self.use_pp = "stage" in names and shape["stage"] > 1
+        from tpu_dist.parallel.overlap import validate_tp_impl
+        validate_tp_impl(cfg.tp_impl)
+        self.use_ring = self.use_tp and cfg.tp_impl == "ring"
+        self.use_bucket = cfg.grad_bucket_mb > 0
         self._validate_mode()
         self.mode = (f"pp-{cfg.pp_schedule}"
                      + ("+tp" if self.use_pp and self.use_tp else "")
@@ -89,9 +93,11 @@ class LMTrainer:
                      "sp-ring" if self.use_sp else
                      ("ep-moe" + ("+tp" if self.use_tp else ""))
                      if self.use_ep else
-                     "tp" if self.use_tp else
+                     ("tp-ring" if self.use_ring else "tp") if self.use_tp
+                     else
                      "fsdp" if cfg.fsdp else
-                     ("dp-moe" if cfg.num_experts else "dp"))
+                     ("dp-moe" if cfg.num_experts else "dp")
+                     + ("-bucketed" if self.use_bucket else ""))
 
         # ---- batch geometry ----
         nprocs = jax.process_count()
@@ -262,6 +268,16 @@ class LMTrainer:
                     aux_weight=cfg.moe_aux_weight)
                 self.window_eval_step = make_lm_sp_indexed_eval_step(
                     self._sp_ctor, self.mesh, loss_chunk=cfg.loss_chunk)
+            elif self.use_ring or self.use_bucket:
+                # the explicit-collective modes scan index windows inside
+                # their own shard_map program; eval (forward-only, no grad
+                # sync, replicated params) rides the GSPMD indexed step
+                from tpu_dist.engine.lm_steps import (
+                    make_lm_explicit_indexed_multi_train_step)
+                self.window_step = make_lm_explicit_indexed_multi_train_step(
+                    self._explicit_step_fn, self.mesh)
+                self.window_eval_step = make_lm_indexed_eval_step(
+                    self.model, self.mesh, loss_chunk=cfg.loss_chunk)
             else:
                 self.window_step = make_lm_indexed_multi_train_step(
                     self.model, self.tx, self.mesh,
@@ -332,6 +348,14 @@ class LMTrainer:
         # run observability: ledger + tracer + skew monitor + hang watchdog
         # (obs.RunObs) — the LM engine's step records carry tok/s + MFU
         self.obs = RunObs("lm", cfg, self.mesh, unit="tok/s")
+        # comm phase for the step ledger records: when grad sync is an
+        # explicit decomposed collective (grad_bucket_mb), time the sync
+        # alone once — the UNOVERLAPPED per-step comm cost readers compare
+        # device_s against (tools/ledger_report renders the share). Ring
+        # TP's comm interleaves with the matmul chunks by construction and
+        # cannot be isolated post-fusion, so its records carry comm_s=None.
+        self._comm_probe_s = (self._measure_comm_probe()
+                              if self.use_bucket else None)
 
     # ------------------------------------------------------------------
     def _validate_mode(self):
@@ -368,6 +392,34 @@ class LMTrainer:
         if cfg.fsdp and (self.use_sp or self.use_tp or self.use_ep):
             self.log("warning: fsdp applies to the pure data-parallel "
                      "layout; ignored with a seq/model/expert mesh axis")
+        if self.use_ring:
+            tp = self.mesh.shape["model"]
+            if self.use_pp or self.use_ep:
+                raise ValueError("tp_impl='ring' drives the pure "
+                                 "data x model layout; pp/ep compositions "
+                                 "ride the GSPMD impl")
+            if cfg.seq_len % tp:
+                raise ValueError(f"tp_impl='ring' seq-shards the residual: "
+                                 f"seq_len {cfg.seq_len} must divide by the "
+                                 f"model axis ({tp})")
+            if cfg.num_heads % tp:
+                raise ValueError(f"tp_impl='ring' shards heads: num_heads "
+                                 f"{cfg.num_heads} must divide by the model "
+                                 f"axis ({tp})")
+            if cfg.grad_accum_steps > 1:
+                raise ValueError("tp_impl='ring' does not compose with "
+                                 "grad_accum_steps > 1 yet (the accum step "
+                                 "is GSPMD-partitioned)")
+        if self.use_bucket:
+            if self.use_tp or self.use_sp or self.use_pp or self.use_ep \
+                    or cfg.fsdp:
+                raise ValueError(
+                    "grad_bucket_mb > 0 decomposes the pure-dp gradient "
+                    "allreduce (replicated params); fsdp/tp/sp/pp/ep keep "
+                    "their GSPMD-scheduled sync")
+            if cfg.grad_accum_steps > 1:
+                raise ValueError("grad_bucket_mb does not compose with "
+                                 "grad_accum_steps > 1 yet")
 
     def _build_model(self):
         cfg = self.cfg
@@ -442,6 +494,43 @@ class LMTrainer:
                 ctor, self.mesh, loss_chunk=cfg.loss_chunk)
             self.data_spec = P("data", "seq")
             self.valid_spec = P("data")
+        elif self.use_ring:
+            # ring collective-matmul TP (parallel.overlap): the train step
+            # runs a tp_impl='ring' CLONE of the model (identical params)
+            # inside shard_map over (data, model); eval and checkpoints keep
+            # the plain model — params are replicated, so the GSPMD eval
+            # step applies unchanged
+            from tpu_dist.engine.lm_steps import (_lm_tp_ring_step_fn,
+                                                  make_lm_tp_ring_train_step)
+            self._ring_model = self.model.clone(tp_impl="ring")
+            self._explicit_step_fn = _lm_tp_ring_step_fn(
+                self._ring_model, self.tx, cfg.moe_aux_weight, "data",
+                "model", self.mesh.shape["model"],
+                loss_chunk=cfg.loss_chunk)
+            self.train_step = make_lm_tp_ring_train_step(
+                self._ring_model, self.tx, self.mesh,
+                loss_chunk=cfg.loss_chunk, aux_weight=cfg.moe_aux_weight)
+            self.eval_step = make_lm_eval_step(
+                self.model, self.mesh, loss_chunk=cfg.loss_chunk)
+            self.data_spec = P("data")
+            self.valid_spec = P("data")
+        elif self.use_bucket:
+            # explicit bucketed dp grad sync (parallel.overlap): DDP's
+            # fusion-buffer decomposition behind --grad-bucket-mb
+            from tpu_dist.engine.lm_steps import (_lm_explicit_dp_step_fn,
+                                                  make_lm_shard_map_train_step)
+            self._explicit_step_fn = _lm_explicit_dp_step_fn(
+                self.model, self.tx, cfg.moe_aux_weight, "data",
+                self.mesh.shape["data"], cfg.grad_bucket_mb,
+                loss_chunk=cfg.loss_chunk)
+            self.train_step = make_lm_shard_map_train_step(
+                self.model, self.tx, self.mesh,
+                grad_bucket_mb=cfg.grad_bucket_mb,
+                loss_chunk=cfg.loss_chunk, aux_weight=cfg.moe_aux_weight)
+            self.eval_step = make_lm_eval_step(
+                self.model, self.mesh, loss_chunk=cfg.loss_chunk)
+            self.data_spec = P("data")
+            self.valid_spec = P("data")
         else:
             self.train_step = make_lm_train_step(
                 self.model, self.tx, self.mesh, loss_chunk=cfg.loss_chunk,
@@ -460,6 +549,10 @@ class LMTrainer:
         if self.use_ep:
             from tpu_dist.parallel.ep import shard_state_ep
             return shard_state_ep(self.mesh, st)
+        if self.use_ring:
+            # ring TP keeps params replicated (each device slices its
+            # column/row shard at use — parallel.overlap design note)
+            return jax.device_put(st, replicated(self.mesh))
         if self.use_tp:
             from tpu_dist.parallel.tp import shard_lm_params
             return TrainState(
@@ -474,6 +567,28 @@ class LMTrainer:
         return jax.device_put(st, replicated(self.mesh))
 
     # ------------------------------------------------------------------
+    def _measure_comm_probe(self) -> float:
+        """Wall seconds of ONE standalone bucketed grad sync at this run's
+        exact bucket geometry (zeros in the params' shapes) — the comm_s
+        estimate stamped on step ledger records. One extra tiny compile,
+        paid only when grad_bucket_mb > 0."""
+        import jax.numpy as jnp
+        from tpu_dist._compat import shard_map
+        from tpu_dist.parallel.overlap import bucketed_grad_sync
+
+        n = self.mesh.shape["data"]
+        mb = self.cfg.grad_bucket_mb
+        sync = jax.jit(shard_map(
+            lambda g: bucketed_grad_sync(g, "data", mb, mean=True,
+                                         axis_size=n),
+            mesh=self.mesh, in_specs=P(), out_specs=P(), check_vma=False))
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             self.state.params)
+        jax.block_until_ready(sync(zeros))  # compile + warm
+        t0 = time.time()
+        jax.block_until_ready(sync(zeros))
+        return time.time() - t0
+
     def log(self, *a, **kw):
         if getattr(self, "is_main", jax.process_index() == 0):
             print(*a, **kw, flush=True)
@@ -528,6 +643,8 @@ class LMTrainer:
                 device_s=share, device_flops=self._device_step_flops(),
                 steps_in_dispatch=info["n_steps"],
                 warm=info.get("warm", False),
+                comm_s=(self._comm_probe_s * info["n_steps"]
+                        if self._comm_probe_s else None),
                 hbm_bytes_in_use=hbm.get("bytes_in_use"),
                 hbm_peak_bytes=hbm.get("peak_bytes_in_use"))
         pending.clear()

@@ -36,7 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_dist.engine.state import TrainState
 from tpu_dist.engine.steps import _apply_update
 from tpu_dist.ops.fused_xent import chunked_softmax_xent
-from tpu_dist.parallel.mesh import DATA_AXIS, SEQ_AXIS
+from tpu_dist.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 
 LM_METRIC_KEYS = ("loss_sum", "correct1", "count")
@@ -236,6 +236,155 @@ def make_lm_grad_accum_train_step(model, tx, mesh: Mesh,
     return jax.jit(step, in_shardings=(None, batch_sh, batch_sh, repl),
                    out_shardings=(None, repl),
                    donate_argnums=(0,) if donate else ())
+
+
+# ---- explicit-collective dp + ring-TP steps (parallel.overlap) -------------
+
+def _lm_explicit_dp_step_fn(model, tx, aux_weight: float, data_axis: str,
+                            axis_size: int, grad_bucket_mb: float,
+                            loss_chunk: int = 0) -> Callable:
+    """Per-device dp step with EXPLICIT gradient sync: local-batch grads,
+    then either one monolithic per-leaf pmean (bucket_mb <= 0) or DDP-style
+    bucketed reduce-scatter+all-gather collectives
+    (parallel.overlap.bucketed_grad_sync). Same math as the jit/GSPMD dp
+    step — the local mean pmean'd equals the global-batch mean."""
+    from tpu_dist.parallel.overlap import bucketed_grad_sync
+
+    def step(state: TrainState, inputs, targets, rng):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+        grads, metrics = _lm_grads_and_metrics(
+            model, aux_weight, state.params, inputs, targets, dropout_rng,
+            loss_chunk)
+        if grad_bucket_mb > 0:
+            grads = bucketed_grad_sync(grads, data_axis, grad_bucket_mb,
+                                       mean=True, axis_size=axis_size)
+        else:
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, data_axis), grads)
+        metrics = jax.tree.map(lambda m: jax.lax.psum(m, data_axis), metrics)
+        return _apply_update(tx, state, grads, {}, metrics)
+
+    return step
+
+
+def _lm_tp_ring_step_fn(model, tx, aux_weight: float, data_axis: str,
+                        model_axis: str, n_model: int,
+                        loss_chunk: int = 0) -> Callable:
+    """Per-device dp x ring-TP step: ``model`` must be built with
+    tp_impl='ring' (parallel.overlap), so its projections run the
+    AG-matmul / matmul-RS collective matmuls over ``model_axis`` and its
+    outputs are this device's (B, L/n_model, ...) sequence chunk — the
+    targets are sliced to match. Params stay replicated (ring trades
+    GSPMD-TP's param sharding for explicit overlap); like the sp step,
+    equal static shard sizes make the pmean of local-mean grads the global
+    mean, with ``model_axis`` joining the reduction because every device
+    holds the full param copy."""
+
+    def step(state: TrainState, inputs, targets, rng):
+        m_idx = jax.lax.axis_index(model_axis)
+        shard_len = targets.shape[1] // n_model
+        tgt = jax.lax.dynamic_slice_in_dim(targets, m_idx * shard_len,
+                                           shard_len, axis=1)
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(p):
+            out, aux, mass_sum, mass_n = _apply_collect_aux(
+                model, p, inputs, dropout_rng,
+                return_features=bool(loss_chunk))
+            loss_sum, metrics = _lm_objective_metrics(
+                model, p, out, tgt, loss_chunk)
+            metrics = {**metrics,
+                       "router_mass_sum": jax.lax.stop_gradient(mass_sum),
+                       "router_mass_n": mass_n}
+            # LOCAL mean over this device's (batch shard x seq chunk);
+            # collectives stay OUT of the differentiated function (the
+            # _lm_sp_step_fn contract — mean-of-local-means == global mean)
+            mean = loss_sum / jnp.maximum(metrics["count"], 1.0)
+            return mean + aux_weight * aux, metrics
+
+        (_, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        grads = jax.tree.map(
+            lambda g: jax.lax.pmean(jax.lax.pmean(g, model_axis), data_axis),
+            grads)
+        metrics = jax.tree.map(
+            lambda m: jax.lax.psum(jax.lax.psum(m, model_axis), data_axis),
+            metrics)
+        return _apply_update(tx, state, grads, {}, metrics)
+
+    return step
+
+
+def _wrap_explicit_step(step_fn, mesh: Mesh, data_axis: str,
+                        donate: bool) -> Callable:
+    """shard_map + jit one of the explicit per-device LM step fns: state
+    and rng replicated, token batch sharded on 'data' (full sequence —
+    ring slices its own chunk), TrainState donated."""
+    sharded = shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(P(), P(data_axis), P(data_axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_lm_shard_map_train_step(model, tx, mesh: Mesh,
+                                 data_axis: str = DATA_AXIS,
+                                 aux_weight: float = 0.01,
+                                 grad_bucket_mb: float = 25.0,
+                                 donate: bool = True,
+                                 loss_chunk: int = 0) -> Callable:
+    """Explicit-collective dp LM step — the LM twin of steps.py
+    make_shard_map_train_step, carrying the ``grad_bucket_mb`` knob:
+    gradient sync as independent ~25MB bucket reduce-scatters (DDP's
+    overlap decomposition) instead of whatever single fused all-reduce
+    GSPMD would emit. bucket_mb <= 0 keeps one monolithic pmean."""
+    step = _lm_explicit_dp_step_fn(model, tx, aux_weight, data_axis,
+                                   mesh.shape[data_axis], grad_bucket_mb,
+                                   loss_chunk)
+    return _wrap_explicit_step(step, mesh, data_axis, donate)
+
+
+def make_lm_tp_ring_train_step(model, tx, mesh: Mesh,
+                               data_axis: str = DATA_AXIS,
+                               model_axis: str = MODEL_AXIS,
+                               aux_weight: float = 0.01,
+                               donate: bool = True,
+                               loss_chunk: int = 0) -> Callable:
+    """dp x TP step over the ring collective matmul (tp_impl='ring'):
+    shard_map over (data, model), batch sharded on 'data', the model's
+    ppermute rings running over 'model'. ``model`` must be built with
+    tp_impl='ring'. Loss parity with the GSPMD TP step is exact for fp
+    (tests/test_overlap.py); int8 quantizes per feature shard (finer
+    granularity than GSPMD's global per-row amax), so quant parity is
+    loss-level, not bitwise."""
+    step = _lm_tp_ring_step_fn(model, tx, aux_weight, data_axis, model_axis,
+                               mesh.shape[model_axis], loss_chunk)
+    return _wrap_explicit_step(step, mesh, data_axis, donate)
+
+
+def make_lm_explicit_indexed_multi_train_step(step_fn, mesh: Mesh,
+                                              data_axis: str = DATA_AXIS,
+                                              donate: bool = True) -> Callable:
+    """K steps per dispatch for the explicit-collective LM steps
+    (_lm_explicit_dp_step_fn / _lm_tp_ring_step_fn): a lax.scan over
+    (K, B) index windows INSIDE the shard_map program, gathering rows from
+    the HBM-resident (N, L+1) matrix and shifting on device — the explicit
+    twin of make_lm_indexed_multi_train_step, same signature:
+    (state, rows_all REPLICATED, idx (K, B) sharded (None, data), rng)."""
+
+    def per_device(state: TrainState, rows_all, idx, rng):
+        def body(st, idx_b):
+            rows = jnp.take(rows_all, idx_b, axis=0)     # (B_local, L+1)
+            return step_fn(st, rows[:, :-1], rows[:, 1:], rng)
+        state, metrics_k = jax.lax.scan(body, state, idx)
+        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
+
+    sharded = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P(), P(None, data_axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 def _lm_eval_metrics(model, params, inputs, targets, mask,

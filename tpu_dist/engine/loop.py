@@ -113,11 +113,48 @@ class Trainer:
                     f"--quant {cfg.quant} applies to the transformer-family "
                     f"image archs (vit_*); arch {cfg.arch!r} does not take it")
             model_kw["quant"] = cfg.quant
+        from tpu_dist.parallel.overlap import validate_tp_impl
+        validate_tp_impl(cfg.tp_impl)
+        if cfg.tp_impl == "ring":
+            # ring collective-matmul TP (parallel.overlap) for the
+            # transformer-family image archs: needs the explicit-collective
+            # engine (the ppermute rings run inside its shard_map) and a
+            # 'model' mesh axis for them to ride
+            if not cfg.arch.startswith("vit"):
+                raise ValueError(
+                    f"--tp-impl ring applies to the transformer-family "
+                    f"image archs (vit_*); arch {cfg.arch!r} has no "
+                    "column/row-parallel projections")
+            if cfg.variant != "shard_map":
+                raise ValueError("--tp-impl ring requires "
+                                 "variant='shard_map' (the ring collectives "
+                                 "are explicit)")
+            if "model" not in self.mesh.axis_names \
+                    or self.mesh.shape["model"] < 2:
+                raise ValueError("--tp-impl ring needs a 'model' mesh axis "
+                                 "of size >= 2 (e.g. --mesh-shape=-1,2 "
+                                 "--mesh-axes=data,model)")
+        if cfg.grad_bucket_mb > 0 and cfg.variant != "shard_map":
+            raise ValueError("--grad-bucket-mb decomposes the explicit "
+                             "gradient allreduce; it requires "
+                             "variant='shard_map' (the jit flavor's sync "
+                             "is GSPMD-scheduled)")
         self.model = create_model(
             cfg.arch, num_classes=self.num_classes,
             dtype=self.policy.compute_dtype, pretrained=cfg.pretrained,
             warmstart_handled=True,  # grafted below (registry guard)
             **model_kw)
+        if cfg.tp_impl == "ring":
+            # config-time twin of the LMTrainer check: each shard's qkv
+            # slice must hold whole heads (vit_tiny's 3 heads cannot split
+            # over a 2-wide model axis)
+            tp = self.mesh.shape["model"]
+            heads = getattr(self.model, "num_heads", 0)
+            if heads % tp:
+                raise ValueError(
+                    f"--tp-impl ring shards attention heads: num_heads "
+                    f"{heads} of {cfg.arch!r} must divide by the 'model' "
+                    f"axis ({tp})")
 
         seed = cfg.seed if cfg.seed is not None else 0
         self.rng = jax.random.PRNGKey(seed)
@@ -197,11 +234,18 @@ class Trainer:
             self.train_step = make_grad_accum_train_step(
                 self.model, self.tx, self.transform, self.mesh)
         elif cfg.variant == "shard_map":
+            # ring TP trains through a tp_impl='ring' CLONE (identical
+            # params — parallel.overlap); init/eval/checkpoints keep the
+            # plain model, which the replicated params drive unchanged
+            train_model = (self.model.clone(tp_impl=cfg.tp_impl)
+                           if cfg.tp_impl != "gspmd" else self.model)
             self.train_step = make_shard_map_train_step(
-                self.model, self.tx, self.transform, self.mesh,
+                train_model, self.tx, self.transform, self.mesh,
                 grad_compression=cfg.grad_compression,
                 predivide_factor=cfg.gradient_predivide_factor,
-                adasum=cfg.adasum)
+                adasum=cfg.adasum,
+                grad_bucket_mb=cfg.grad_bucket_mb,
+                model_axis="model" if cfg.tp_impl == "ring" else None)
         else:
             self.train_step = make_train_step(
                 self.model, self.tx, self.transform, self.mesh)

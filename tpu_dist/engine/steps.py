@@ -336,7 +336,9 @@ def make_shard_map_train_step(model, tx, transform, mesh: Mesh,
                               grad_compression: str = "none",
                               predivide_factor: float = 1.0,
                               adasum: bool = False,
-                              donate: bool = True) -> Callable:
+                              donate: bool = True,
+                              grad_bucket_mb: float = 0.0,
+                              model_axis: Optional[str] = None) -> Callable:
     """Explicit-collective step (horovod-equivalent, reference variant 5).
 
     Per-device program via shard_map; gradient averaging is an explicit psum
@@ -347,10 +349,22 @@ def make_shard_map_train_step(model, tx, transform, mesh: Mesh,
     operator (hvd.Adasum, reference 5.2...py:184 —
     tpu_dist.parallel.collectives.adasum_reduce); predivide/compression are
     mean-path knobs and do not apply.
+
+    ``grad_bucket_mb > 0`` replaces the tree-wide psum with DDP-style
+    size-targeted bucket collectives (parallel.overlap.bucketed_grad_sync:
+    independent reduce-scatter+all-gather per ~bucket_mb of grads), the
+    decomposition XLA's scheduler can overlap. ``model_axis`` names a ring-TP
+    mesh axis (models built with tp_impl='ring'/'ring_ar'): the model's
+    collectives run over it inside this same program, compute is replicated
+    across it per data shard, and the grads of the (replicated) params are
+    additionally pmean'd over it.
     """
     repl = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(data_axis))
     nrep = mesh.shape[data_axis]
+    if adasum and grad_bucket_mb > 0:
+        raise ValueError("grad_bucket_mb decomposes the mean allreduce; "
+                         "adasum replaces it — the two are exclusive")
 
     def per_device(state: TrainState, images_u8, labels, rng):
         dropout_rng, aug_rng = jax.random.split(
@@ -362,6 +376,12 @@ def make_shard_map_train_step(model, tx, transform, mesh: Mesh,
                                         state.loss_scale, True),
             has_aux=True)
         (_, (new_stats, metrics)), grads = grad_fn(state.params)
+        if model_axis is not None:
+            # ring TP: params are replicated over the model axis while the
+            # per-device losses are identical across it — the mean restores
+            # the single-loss gradient (overlap.py scaling note)
+            grads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, model_axis), grads)
         if adasum:
             from tpu_dist.parallel.collectives import adasum_reduce
             grads = adasum_reduce(grads, data_axis, nrep)
@@ -370,7 +390,12 @@ def make_shard_map_train_step(model, tx, transform, mesh: Mesh,
             pre = predivide_factor if predivide_factor != 1.0 else nrep
             grads = jax.tree.map(lambda g: g / pre, grads)
             down, up = compress_grads(grads, grad_compression)
-            down = jax.tree.map(lambda g: jax.lax.psum(g, data_axis), down)
+            if grad_bucket_mb > 0:
+                from tpu_dist.parallel.overlap import bucketed_grad_sync
+                down = bucketed_grad_sync(down, data_axis, grad_bucket_mb,
+                                          mean=False, axis_size=nrep)
+            else:
+                down = jax.tree.map(lambda g: jax.lax.psum(g, data_axis), down)
             grads = up(down)
             if predivide_factor != 1.0:
                 grads = jax.tree.map(lambda g: g * (predivide_factor / nrep),

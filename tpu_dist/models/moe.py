@@ -168,25 +168,37 @@ class MoEBlock(nn.Module):
     group_size: int = 512
     capacity_factor: float = 1.25
     quant: str = "none"
+    tp_impl: str = "gspmd"  # ring = collective-matmul attention projections
+                            # over a seq-sharded residual (parallel.overlap);
+                            # the MoE MLP then routes SHARD-LOCALLY, the same
+                            # composition contract as MoE x sp
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False):
         from tpu_dist.models.transformer import (attend_maybe_cached,
                                                  full_attention)
 
+        ring = self.tp_impl != "gspmd"
+        if ring and decode:
+            raise ValueError("tp_impl='ring' is a training path; decode "
+                             "rides the GSPMD layers")
         attn = self.attn_fn or full_attention
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
+        tp = dict(tp_impl=self.tp_impl) if ring else {}
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         qkv = make_dense(3 * d_model, use_bias=False, dtype=self.dtype,
-                         name="qkv", quant=self.quant)(h)
+                         name="qkv", quant=self.quant,
+                         tp_kind="column", tp_fused=3, **tp)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        shp = (x.shape[0], x.shape[1], self.num_heads, head_dim)
+        shp = (q.shape[0], q.shape[1], -1, head_dim)  # local heads if ring
         out = attend_maybe_cached(self, q.reshape(shp), k.reshape(shp),
                                   v.reshape(shp), decode=decode,
                                   attn_fn=attn, dtype=self.dtype)
+        out = out.reshape(out.shape[0], out.shape[1], -1)
         x = x + make_dense(d_model, use_bias=False, dtype=self.dtype,
-                           name="proj", quant=self.quant)(out.reshape(x.shape))
+                           name="proj", quant=self.quant,
+                           tp_kind="row", **tp)(out)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         x = x + MoEMLP(self.num_experts, dtype=self.dtype,
                        router_top_k=self.router_top_k,
@@ -226,6 +238,9 @@ class MoETransformerLM(nn.Module):
     quant: str = "none"  # none | int8 | int8_wo (ops.quant): attention
                          # projections + expert matmuls + lm_head; router
                          # gate and dispatch/combine stay fp
+    tp_impl: str = "gspmd"  # ring = seq-sharded collective-matmul attention
+                            # with shard-local expert routing (MoEBlock;
+                            # group_size must divide the shard's tokens)
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, pos_offset=0,
@@ -239,12 +254,18 @@ class MoETransformerLM(nn.Module):
         pos = pos_offset + jnp.arange(tokens.shape[1])
         x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
                          name="pos_emb")(pos)[None]
+        if self.tp_impl == "ring":
+            if decode:
+                raise ValueError("tp_impl='ring' is a training path; "
+                                 "decode rides the GSPMD layers")
+            from tpu_dist.parallel.overlap import seq_shard
+            x = seq_shard(x)
         block_cls = (nn.remat(MoEBlock, static_argnums=(2, 3)) if self.remat
                      else MoEBlock)
         for i in range(self.num_layers):
             x = block_cls(self.num_heads, self.num_experts, self.dtype,
                           self.attn_fn, self.router_top_k, self.group_size,
-                          self.capacity_factor, self.quant,
+                          self.capacity_factor, self.quant, self.tp_impl,
                           name=f"block{i}")(x, train, decode)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_features:

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_dist.ops.quant import make_dense
+from tpu_dist.parallel.mesh import MODEL_AXIS
 
 
 def full_attention(q, k, v, *, causal: bool = True,
@@ -86,28 +87,56 @@ class Block(nn.Module):
     quant: str = "none"  # none | int8 | int8_wo — dense/attention
                          # projections via ops.quant (the attention
                          # contraction itself and the norms stay fp)
+    tp_impl: str = "gspmd"  # gspmd (compiler-partitioned, the default) |
+                            # ring (AG-matmul / matmul-RS collective matmul
+                            # over a seq-sharded residual, inside shard_map
+                            # with the 'model' axis bound) | ring_ar
+                            # (full-token residual, chunked ring allreduce
+                            # of the row partials — parallel.overlap)
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False):
+        ring = self.tp_impl != "gspmd"
+        if ring and decode:
+            raise ValueError("tp_impl='ring' is a training path; decode "
+                             "rides the GSPMD layers")
+        if ring:
+            # fail with the real constraint, not a reshape error three ops
+            # later: each shard's qkv slice must hold whole heads
+            from tpu_dist.parallel.overlap import static_axis_size
+            n = static_axis_size(MODEL_AXIS)
+            if self.num_heads % n:
+                raise ValueError(
+                    f"tp_impl='{self.tp_impl}' shards attention heads: "
+                    f"num_heads {self.num_heads} must divide by the "
+                    f"'{MODEL_AXIS}' axis ({n})")
+        # under tp_impl='ring' the residual x is this device's SEQUENCE
+        # chunk (B, L/n, D): the column projections gather the full
+        # sequence for a head/feature shard, the row projections scatter
+        # it back reduced — all shapes below derive from the inputs, so
+        # one body serves the replicated and both ring dataflows
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
+        tp = dict(tp_impl=self.tp_impl) if ring else {}
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         qkv = make_dense(3 * d_model, use_bias=False, dtype=self.dtype,
-                         name="qkv", quant=self.quant)(h)
+                         name="qkv", quant=self.quant,
+                         tp_kind="column", tp_fused=3, **tp)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        shp = (x.shape[0], x.shape[1], self.num_heads, head_dim)
+        shp = (q.shape[0], q.shape[1], -1, head_dim)  # local heads if ring
         q, k, v = q.reshape(shp), k.reshape(shp), v.reshape(shp)
         out = attend_maybe_cached(self, q, k, v, decode=decode,
                                   attn_fn=self.attn_fn, dtype=self.dtype)
-        out = out.reshape(x.shape)
+        out = out.reshape(out.shape[0], out.shape[1], -1)
         x = x + make_dense(d_model, use_bias=False, dtype=self.dtype,
-                           name="proj", quant=self.quant)(out)
+                           name="proj", quant=self.quant,
+                           tp_kind="row", **tp)(out)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         h = make_dense(4 * d_model, dtype=self.dtype, name="mlp_in",
-                       quant=self.quant)(h)
+                       quant=self.quant, tp_kind="column", **tp)(h)
         h = nn.gelu(h)
         x = x + make_dense(d_model, dtype=self.dtype, name="mlp_out",
-                           quant=self.quant)(h)
+                           quant=self.quant, tp_kind="row", **tp)(h)
         return x
 
 
@@ -128,6 +157,14 @@ class TransformerLM(nn.Module):
                          # attention projections + lm_head; param tree is
                          # IDENTICAL to the unquantized model, so the knob
                          # composes with checkpoints and every sharding
+    tp_impl: str = "gspmd"  # gspmd (declarative TP via parallel.tp specs)
+                            # | ring (manual collective-matmul TP inside
+                            # shard_map over the 'model' axis — parallel.
+                            # overlap; param tree IDENTICAL, so both impls
+                            # load the same checkpoints). Under ring the
+                            # residual stream is seq-sharded between the
+                            # projections; outputs are this device's
+                            # (B, L/n, ...) sequence chunk.
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, pos_offset=0,
@@ -145,14 +182,27 @@ class TransformerLM(nn.Module):
         pos = pos_offset + jnp.arange(tokens.shape[1])
         x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
                          name="pos_emb")(pos)[None]
+        if self.tp_impl == "ring":
+            # enter the seq-sharded ring residual: from here each device
+            # carries its (B, L/n, D) chunk; the blocks' column/row ring
+            # projections gather/scatter around it (parallel.overlap)
+            if decode:
+                raise ValueError("tp_impl='ring' is a training path; "
+                                 "decode rides the GSPMD layers")
+            from tpu_dist.parallel.overlap import seq_shard
+            x = seq_shard(x)
         block_cls = (nn.remat(Block, static_argnums=(2, 3)) if self.remat
                      else Block)
         for i in range(self.num_layers):
             x = block_cls(self.num_heads, self.dtype, self.attn_fn,
-                          self.quant, name=f"block{i}")(x, train, decode)
+                          self.quant, self.tp_impl,
+                          name=f"block{i}")(x, train, decode)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_features:
             return x
+        # the head stays a full local matmul under ring (kernel replicated,
+        # rows = this device's seq chunk), so the fp32 softmax/loss math is
+        # untouched; parity with GSPMD's vocab-sharded head is exact
         logits = make_dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                             name="lm_head", quant=self.quant)(x)
         return logits.astype(jnp.float32)
@@ -160,8 +210,8 @@ class TransformerLM(nn.Module):
 
 def tiny_lm(vocab_size=256, num_layers=2, d_model=64, num_heads=4,
             max_len=512, dtype=jnp.float32, attn_fn=full_attention,
-            remat=False, quant="none", **_):
+            remat=False, quant="none", tp_impl="gspmd", **_):
     return TransformerLM(vocab_size=vocab_size, num_layers=num_layers,
                         d_model=d_model, num_heads=num_heads, max_len=max_len,
                         dtype=dtype, attn_fn=attn_fn, remat=remat,
-                        quant=quant)
+                        quant=quant, tp_impl=tp_impl)

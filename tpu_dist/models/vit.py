@@ -42,6 +42,16 @@ class ViT(nn.Module):
     quant: str = "none"  # none | int8 | int8_wo — quantized block matmuls
                          # (ops.quant); the patch-embed conv and the tiny
                          # classifier head stay in the compute dtype
+    tp_impl: str = "gspmd"  # ring = collective-matmul TP for the block
+                            # projections inside shard_map over 'model'.
+                            # The [CLS] token makes the token count odd, so
+                            # the sequence axis cannot shard evenly: ViT
+                            # maps 'ring' onto the full-token 'ring_ar'
+                            # flavor (parallel.overlap) — column shards are
+                            # local slices and the row-parallel reduction is
+                            # the chunked ppermute ring_allreduce, so the
+                            # overlap decomposition is preserved without a
+                            # divisibility demand on tokens
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -58,9 +68,10 @@ class ViT(nn.Module):
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, x.shape[1], self.d_model))
         x = x + pos.astype(self.dtype)
+        block_tp = "ring_ar" if self.tp_impl == "ring" else self.tp_impl
         for i in range(self.num_layers):
             x = Block(self.num_heads, self.dtype, self.attn_fn, self.quant,
-                      name=f"block{i}")(x, train)
+                      block_tp, name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(self.num_classes, dtype=self.dtype,
                           name="head")(x[:, 0])

@@ -122,13 +122,16 @@ class RunObs:
              wall_s: float, data_s: float, dispatch_s: float,
              device_s: float, device_flops: Optional[float] = None,
              steps_in_dispatch: int = 1, warm: bool = False,
-             **extra) -> dict:
+             comm_s: Optional[float] = None, **extra) -> dict:
         """Record one optimizer step (or one K-step dispatch window).
 
         ``n_items`` is the GLOBAL item count of the record (images or
         tokens across all steps in the dispatch); ``device_flops`` is the
         per-device model FLOPs of ONE optimizer step, from which TFLOP/s
-        and MFU derive. ``warm=True`` marks the record that carried the
+        and MFU derive. ``comm_s`` is the communication share of the
+        dispatch where the engine can isolate it (explicit bucketed grad
+        sync: a standalone-probe estimate; None under fused/GSPMD
+        schedules) — it OVERLAPS device_s, see the EVENT_SCHEMA note. ``warm=True`` marks the record that carried the
         XLA compile (its dispatch_s is compile-dominated; ledger_report
         excludes warm records from phase shares and trends, matching the
         loops' own warm-excluded throughput convention). Also feeds the
@@ -149,6 +152,7 @@ class RunObs:
             throughput=round(throughput, 1), unit=self.unit,
             data_s=round(data_s, 6), dispatch_s=round(dispatch_s, 6),
             device_s=round(device_s, 6),
+            comm_s=round(comm_s, 6) if comm_s is not None else None,
             mfu=float(f"{mfu:.4g}") if mfu is not None else None,
             tflops=float(f"{tflops:.4g}") if tflops is not None else None,
             steps_in_dispatch=steps_in_dispatch, warm=warm, **extra)

@@ -44,9 +44,15 @@ EVENT_SCHEMA = {
     # first-dispatch / AOT-probe record (program stats, warm seconds)
     "compile": ("program",),
     # one optimizer step (or one K-step dispatch window: steps_in_dispatch
-    # carries the window size) with the full phase breakdown
+    # carries the window size) with the full phase breakdown. comm_s is the
+    # communication share: unlike the other phases it OVERLAPS device_s
+    # (that is the point of parallel.overlap), so it is reported beside the
+    # share table, not inside it. None where the engine cannot isolate it
+    # (fused GSPMD sync, ring TP interleaving); the explicit bucketed-sync
+    # mode stamps a standalone-probe estimate, tools/comm_bench.py measures
+    # it exactly (its programs are pure communication).
     "step": ("step", "loss", "throughput", "unit",
-             "data_s", "dispatch_s", "device_s", "mfu"),
+             "data_s", "dispatch_s", "device_s", "comm_s", "mfu"),
     # end-of-epoch rollup (the legacy per-epoch CSV row renders from this)
     "epoch": ("epoch", "start_ts", "seconds", "throughput", "unit", "loss"),
     # held-out evaluation
@@ -247,8 +253,10 @@ class ProgressSink:
 
 def phase_totals(records) -> Dict[str, float]:
     """Sum the per-step phase seconds across a record list — the per-phase
-    time-share rollup ledger_report and bench publish."""
-    tot = {"data_s": 0.0, "dispatch_s": 0.0, "device_s": 0.0}
+    time-share rollup ledger_report and bench publish. ``comm_s`` rides
+    along but OVERLAPS device_s (schema note), so share denominators must
+    exclude it."""
+    tot = {"data_s": 0.0, "dispatch_s": 0.0, "device_s": 0.0, "comm_s": 0.0}
     for rec in records:
         if rec.get("event") != "step":
             continue

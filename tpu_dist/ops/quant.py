@@ -200,10 +200,24 @@ class QuantDense(nn.Module):
 
 def make_dense(features: int, *, use_bias: bool = True,
                dtype=jnp.float32, name: Optional[str] = None,
-               quant: str = "none") -> nn.Module:
+               quant: str = "none", tp_impl: str = "gspmd",
+               tp_kind: Optional[str] = None, tp_fused: int = 1) -> nn.Module:
     """THE dense-layer factory of the transformer families: ``nn.Dense``
     when quantization is off, :class:`QuantDense` (identical param tree)
-    otherwise — so the quant knob never forks model param structure."""
+    otherwise — so the quant knob never forks model param structure.
+
+    ``tp_impl`` other than 'gspmd' with a ``tp_kind`` ('column'|'row')
+    routes through the ring collective matmul
+    (:class:`tpu_dist.parallel.overlap.RingDense` — still the identical
+    param tree, quant riding the same ring); layers with no parallel role
+    (tp_kind=None, e.g. a replicated lm_head under ring) stay on the
+    plain/quant path whatever the impl."""
+    if tp_impl != "gspmd" and tp_kind is not None:
+        # local import: parallel.overlap imports quant_matmul from here
+        from tpu_dist.parallel.overlap import RingDense
+        return RingDense(features, kind=tp_kind, flavor=tp_impl,
+                         use_bias=use_bias, dtype=dtype, n_fused=tp_fused,
+                         quant=validate_quant(quant), name=name)
     if validate_quant(quant) == "none":
         return nn.Dense(features, use_bias=use_bias, dtype=dtype, name=name)
     return QuantDense(features, mode=quant, use_bias=use_bias, dtype=dtype,

@@ -2,5 +2,9 @@ from tpu_dist.parallel.mesh import (  # noqa: F401
     DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS,
     batch_sharding, make_mesh, replicated, world_info)
 from tpu_dist.parallel.collectives import (  # noqa: F401
-    allreduce_bench, barrier, compress_grads, pmean, psum, reduce_mean)
+    allreduce_bench, barrier, compress_grads, pmean, psum, reduce_mean,
+    ring_allreduce)
+from tpu_dist.parallel.overlap import (  # noqa: F401
+    RingDense, bucketed_grad_sync, ring_allgather_matmul,
+    ring_matmul_reduce_scatter, validate_tp_impl)
 from tpu_dist.parallel import launch  # noqa: F401

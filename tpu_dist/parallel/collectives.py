@@ -138,6 +138,55 @@ def adasum_reduce(tree, axis_name: str = DATA_AXIS, axis_size: int = None,
     return a
 
 
+def ring_allreduce(x, axis_name: str = DATA_AXIS, axis_size: int = None):
+    """Bandwidth-optimal ring all-reduce (sum) via ``lax.ppermute``.
+
+    The classic two-pass decomposition NCCL runs internally (and DDP's
+    bucket allreduce rides on): a reduce-scatter pass — n-1 rounds in which
+    each device forwards a rotating accumulator one hop and adds its local
+    chunk — then an all-gather pass circulating the n fully-reduced chunks.
+    Unlike one fused ``psum``, every round is an independent ppermute whose
+    transfer XLA's latency-hiding scheduler can overlap with whatever
+    compute is adjacent (parallel.overlap builds on exactly this property);
+    the payload per hop is 1/n of the buffer, the bandwidth-optimal
+    schedule. Exposed standalone for tools/comm_bench.py and as the 'ring'
+    reduction flavor of overlap.bucketed_grad_sync.
+
+    Must run inside shard_map with ``axis_name`` bound. Returns the SUM
+    across the axis (psum semantics); callers divide for a mean.
+    """
+    n = axis_size if axis_size is not None else jax.lax.psum(1, axis_name)
+    if n == 1:
+        return x
+    idx = jax.lax.axis_index(axis_name)
+    flat = x.reshape(-1)
+    size = flat.size
+    pad = (-size) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    parts = flat.reshape(n, flat.size // n)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def chunk(j):
+        return jax.lax.dynamic_index_in_dim(parts, j % n, 0, keepdims=False)
+
+    # reduce-scatter: accumulator seeded with chunk (idx-1) lands home on
+    # device (idx) after n-1 forward hops, summing every device's copy
+    acc = chunk(idx - 1)
+    for k in range(1, n):
+        acc = jax.lax.ppermute(acc, axis_name, fwd)
+        acc = acc + chunk(idx - k - 1)
+    # all-gather: circulate the n reduced chunks; after hop k the piece in
+    # flight on device idx is chunk (idx - k)
+    out = jnp.zeros_like(parts)
+    out = jax.lax.dynamic_update_index_in_dim(out, acc, idx, 0)
+    cur = acc
+    for k in range(1, n):
+        cur = jax.lax.ppermute(cur, axis_name, fwd)
+        out = jax.lax.dynamic_update_index_in_dim(out, cur, (idx - k) % n, 0)
+    return out.reshape(-1)[:size].reshape(x.shape)
+
+
 # ---- host-level barrier ----------------------------------------------------
 
 def barrier(mesh: Mesh | None = None) -> None:

@@ -1,0 +1,324 @@
+"""Comm/compute overlap: ring collective matmul + bucketed gradient sync.
+
+The reference repo's whole case for DDP over naive DataParallel is that DDP
+overlaps the gradient all-reduce with the backward pass in ~25MB buckets
+(Li et al., VLDB 2020). tpu_dist's round-1-7 answer to communication was
+declarative: GSPMD decides where the collectives go (parallel.tp) and dp
+grad sync is whatever single fused all-reduce XLA emits. This module adds
+the MANUAL overlap path — decomposed, dependency-broken collectives that
+XLA's latency-hiding scheduler can interleave with compute (Wang et al.,
+ASPLOS 2023 'Overlap Communication with Dependent Computation via
+Decomposition'):
+
+* **ring collective matmul** — the Megatron column/row-parallel projection
+  pair rebuilt as per-shard chunks exchanged with ``lax.ppermute`` inside
+  shard_map: :func:`ring_allgather_matmul` (all-gather-then-matmul: each
+  round matmuls the sequence chunk it holds while the next chunk's
+  transfer is already in flight) and :func:`ring_matmul_reduce_scatter`
+  (matmul-then-reduce-scatter: a rotating accumulator picks up one
+  partial product per hop). :class:`RingDense` packages them as drop-in
+  replacements for the column/row-parallel projections — same
+  ``kernel``/``bias`` names, same FULL param shapes, so checkpoints and
+  the ``quant`` knob apply unchanged (the per-chunk matmul routes through
+  ops.quant.quant_matmul, so int8 rides the same ring).
+* **bucketed gradient sync** — :func:`bucketed_grad_sync` groups grads
+  into size-targeted buckets (DDP's ~25MB fusion-buffer rule) and reduces
+  each as an independent reduce-scatter + all-gather instead of one
+  tree-wide psum, so the scheduler may start bucket k+1's transfer while
+  bucket k completes. Wired into the explicit-collective step builders
+  (engine.steps / engine.lm_steps) behind the ``grad_bucket_mb`` knob.
+
+Two ring flavors, because the sequence axis is not always shardable:
+
+* ``'ring'``  — the headline AG/RS pair above; the residual stream is
+  SEQUENCE-SHARDED over the model axis between projections (Megatron-LM
+  sequence parallelism), so column projections gather and row projections
+  scatter. Needs seq_len % tp == 0 (TransformerLM / MoE blocks).
+* ``'ring_ar'`` — activations stay full-sequence; column projections are
+  local slices (no comm) and row projections end in a chunked
+  :func:`~tpu_dist.parallel.collectives.ring_allreduce` of the partial
+  sums. No divisibility demand on the token axis — the ViT path, whose
+  [CLS] token makes the token count odd by construction.
+
+Everything here runs INSIDE shard_map with the model/data axis bound;
+axis sizes are recovered statically via ``lax.psum(1, axis)`` (constant-
+folded), so shapes stay trace-time constants.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpu_dist.ops.quant import quant_matmul
+from tpu_dist.parallel.collectives import ring_allreduce
+from tpu_dist.parallel.mesh import MODEL_AXIS
+
+TP_IMPLS = ("gspmd", "ring")          # the public knob (configs.*.tp_impl)
+_RING_FLAVORS = ("ring", "ring_ar")   # module-internal flavor set
+
+
+def validate_tp_impl(mode: str) -> str:
+    if mode not in TP_IMPLS:
+        raise ValueError(f"unknown tp_impl {mode!r} ({'|'.join(TP_IMPLS)})")
+    return mode
+
+
+def static_axis_size(axis_name: str) -> int:
+    """STATIC size of a bound mesh axis from inside shard_map: psum of a
+    literal constant-folds to a Python int at trace time."""
+    return jax.lax.psum(1, axis_name)
+
+
+# ---- ring collective matmul ------------------------------------------------
+
+def ring_allgather_matmul(x: jax.Array, w: jax.Array, axis_name: str,
+                          *, matmul: Optional[Callable] = None) -> jax.Array:
+    """all_gather-then-matmul, decomposed: (B, L/n, D) sequence shard x
+    (D, F/n) column shard -> (B, L, F/n), without ever materializing the
+    gathered (B, L, D).
+
+    Round k matmuls the sequence chunk currently held (originally device
+    idx+k's) while that chunk's ppermute to the left neighbor is already
+    issued — the transfer of chunk k+1 hides behind the MXU work of chunk
+    k, which is the whole point of the decomposition.
+    """
+    mm = matmul or jnp.dot
+    n = static_axis_size(axis_name)
+    if n == 1:
+        return mm(x, w)
+    idx = jax.lax.axis_index(axis_name)
+    lm = x.shape[1]
+    perm = [(i, (i - 1) % n) for i in range(n)]  # receive from the right
+    cur = x
+    out = None
+    for k in range(n):
+        # issue the next hop BEFORE this round's matmul: the two are
+        # independent, so the scheduler may overlap transfer and compute
+        nxt = jax.lax.ppermute(cur, axis_name, perm) if k < n - 1 else None
+        y = mm(cur, w)                       # chunk owned by device idx+k
+        if out is None:
+            out = jnp.zeros((y.shape[0], n * lm, y.shape[-1]), y.dtype)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, y, ((idx + k) % n) * lm, axis=1)
+        cur = nxt
+    return out
+
+
+def ring_matmul_reduce_scatter(y: jax.Array, w: jax.Array, axis_name: str,
+                               *, matmul: Optional[Callable] = None
+                               ) -> jax.Array:
+    """matmul-then-reduce_scatter, decomposed: (B, L, F/n) full-sequence
+    activations x (F/n, D) row shard -> (B, L/n, D) fully summed over the
+    axis, this device keeping sequence chunk ``axis_index``.
+
+    A rotating accumulator makes one hop per round and picks up the local
+    partial product for the chunk it is passing through — each round's
+    matmul is independent of the accumulator transfer it overlaps.
+    """
+    mm = matmul or jnp.dot
+    n = static_axis_size(axis_name)
+    if n == 1:
+        return mm(y, w)
+    idx = jax.lax.axis_index(axis_name)
+    lm = y.shape[1] // n
+    if y.shape[1] % n:
+        raise ValueError(f"sequence length {y.shape[1]} not divisible by "
+                         f"the {axis_name} axis ({n})")
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def part(c):
+        return mm(jax.lax.dynamic_slice_in_dim(y, (c % n) * lm, lm, axis=1),
+                  w)
+
+    # accumulator seeded for chunk (idx-1) lands home after n-1 hops
+    acc = part(idx - 1)
+    for k in range(1, n):
+        acc = jax.lax.ppermute(acc, axis_name, perm)
+        acc = acc + part(idx - k - 1)
+    return acc
+
+
+def seq_shard(x: jax.Array, axis_name: str = MODEL_AXIS) -> jax.Array:
+    """This device's sequence chunk of a model-axis-replicated (B, L, ...)
+    activation — the entry point into the seq-sharded ring residual
+    stream. L must divide by the axis size."""
+    n = static_axis_size(axis_name)
+    if n == 1:
+        return x
+    if x.shape[1] % n:
+        raise ValueError(
+            f"tp_impl='ring' shards the sequence over the {axis_name} axis: "
+            f"length {x.shape[1]} is not divisible by {n}")
+    idx = jax.lax.axis_index(axis_name)
+    lm = x.shape[1] // n
+    return jax.lax.dynamic_slice_in_dim(x, idx * lm, lm, axis=1)
+
+
+class RingDense(nn.Module):
+    """Drop-in ring-parallel ``nn.Dense``: identical param names
+    ("kernel"/"bias"), identical FULL param shapes and init — checkpoints,
+    the Megatron TP sharding rules, and the ``quant`` knob all apply
+    unchanged. The weights live replicated; each device slices its
+    column/row shard at use (ring mode trades GSPMD-TP's param-memory
+    sharding for explicit comm/compute overlap — compute and activations
+    still shard over the axis).
+
+    ``kind='column'`` consumes the full contraction dim and produces a
+    feature shard; ``kind='row'`` consumes a feature shard and produces
+    the summed full output. ``flavor`` picks the dataflow (module
+    docstring): 'ring' = AG-matmul / matmul-RS over sequence chunks,
+    'ring_ar' = local slice / chunked ring all-reduce. The inner per-chunk
+    matmul routes through ops.quant.quant_matmul, so 'int8'/'int8_wo'
+    ride the same ring path as fp.
+    """
+
+    features: int
+    kind: str                  # 'column' | 'row'
+    flavor: str = "ring"       # 'ring' | 'ring_ar'
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    quant: str = "none"
+    axis_name: str = MODEL_AXIS
+    n_fused: int = 1           # the kernel fuses this many equal
+                               # projections along the output dim (qkv = 3):
+                               # a column shard takes the idx-th slice of
+                               # EACH segment, so a downstream split stays
+                               # q/k/v-aligned per device
+
+    def _column_shard(self, t: jax.Array, idx, n: int) -> jax.Array:
+        """idx-th output-feature shard of ``t`` (kernel dim -1 / bias dim
+        0), sliced per fused segment."""
+        seg = self.features // self.n_fused
+        fs = seg // n
+        ax = t.ndim - 1
+        return jnp.concatenate(
+            [jax.lax.dynamic_slice_in_dim(t, s * seg + idx * fs, fs, axis=ax)
+             for s in range(self.n_fused)], axis=ax)
+
+    @nn.compact
+    def __call__(self, x):
+        if self.kind not in ("column", "row"):
+            raise ValueError(f"RingDense kind {self.kind!r} (column|row)")
+        if self.flavor not in _RING_FLAVORS:
+            raise ValueError(f"RingDense flavor {self.flavor!r} "
+                             f"({'|'.join(_RING_FLAVORS)})")
+        n = static_axis_size(self.axis_name)
+        idx = jax.lax.axis_index(self.axis_name)
+        if self.kind == "column":
+            d_in = x.shape[-1]
+            if self.features % (self.n_fused * n):
+                raise ValueError(f"features {self.features} not divisible "
+                                 f"by n_fused x the {self.axis_name} axis "
+                                 f"({self.n_fused} x {n})")
+        else:
+            d_in = x.shape[-1] * n
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (d_in, self.features))
+        if self.has_variable("params", "kernel_scale"):
+            raise ValueError(
+                "RingDense got a pre-quantized (wo_quantize_params) kernel; "
+                "the ring path is a training path — decode rides the GSPMD "
+                "layers (quant='int8_wo' with tp_impl='gspmd')")
+        x = x.astype(self.dtype)
+        mm = lambda a, b: quant_matmul(a, b, self.quant)
+        if self.kind == "column":
+            w = self._column_shard(kernel.astype(self.dtype), idx, n)
+            if self.flavor == "ring":
+                y = ring_allgather_matmul(x, w, self.axis_name, matmul=mm)
+            else:          # ring_ar: replicated input, no gather needed
+                y = mm(x, w)
+        else:
+            ls = x.shape[-1]
+            w = jax.lax.dynamic_slice_in_dim(
+                kernel.astype(self.dtype), idx * ls, ls, axis=0)
+            if self.flavor == "ring":
+                y = ring_matmul_reduce_scatter(x, w, self.axis_name,
+                                               matmul=mm)
+            else:          # ring_ar: chunked all-reduce of the partials
+                y = ring_allreduce(mm(x, w), self.axis_name, n)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,)).astype(self.dtype)
+            if self.kind == "column":
+                bias = self._column_shard(bias, idx, n)
+            y = y + bias
+        return y
+
+
+# ---- bucketed gradient sync ------------------------------------------------
+
+GRAD_BUCKET_MB_DEFAULT = 25.0  # DDP's fusion-buffer default (Li et al. §3.2)
+
+
+def grad_buckets(leaves: Sequence[jax.Array],
+                 bucket_bytes: float) -> List[List[int]]:
+    """Group consecutive leaf indices so each bucket targets
+    ``bucket_bytes`` (DDP's fusion-buffer rule): a bucket closes when the
+    next leaf would overflow it, an oversized leaf gets its own bucket,
+    and dtype changes close a bucket (buckets concatenate flat)."""
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    size = 0
+    for i, leaf in enumerate(leaves):
+        b = leaf.size * leaf.dtype.itemsize
+        if cur and (size + b > bucket_bytes
+                    or leaf.dtype != leaves[cur[-1]].dtype):
+            groups.append(cur)
+            cur, size = [], 0
+        cur.append(i)
+        size += b
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def bucketed_grad_sync(tree, axis_name: str,
+                       bucket_mb: float = GRAD_BUCKET_MB_DEFAULT,
+                       mean: bool = True, axis_size: Optional[int] = None,
+                       impl: str = "rs_ag"):
+    """Cross-replica gradient sync as INDEPENDENT size-targeted bucket
+    collectives instead of one fused tree-wide psum — DDP's bucket
+    decomposition, which is what lets the scheduler overlap bucket k+1's
+    transfer with bucket k's completion (and, fused into a step program,
+    with adjacent backward compute).
+
+    Each bucket is flattened+concatenated, padded to the axis size, and
+    reduced as ``psum_scatter`` -> ``all_gather`` (``impl='rs_ag'``, the
+    DDP wire pattern) or a chunked :func:`collectives.ring_allreduce`
+    (``impl='ring'``). ``mean`` divides by the axis size (the dp grad
+    average). Must run inside shard_map with ``axis_name`` bound; operates
+    on the grads only, so buffer donation of the TrainState is untouched.
+    """
+    if impl not in ("rs_ag", "ring", "psum"):
+        raise ValueError(f"unknown bucketed sync impl {impl!r}")
+    n = axis_size if axis_size is not None else static_axis_size(axis_name)
+    leaves, treedef = jax.tree.flatten(tree)
+    out = list(leaves)
+    for group in grad_buckets(leaves, bucket_mb * 1e6):
+        flat = (leaves[group[0]].reshape(-1) if len(group) == 1 else
+                jnp.concatenate([leaves[i].reshape(-1) for i in group]))
+        size = flat.size
+        pad = (-size) % n
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        if impl == "rs_ag":
+            red = jax.lax.all_gather(
+                jax.lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                                     tiled=True),
+                axis_name, tiled=True)
+        elif impl == "ring":
+            red = ring_allreduce(flat, axis_name, n)
+        else:
+            red = jax.lax.psum(flat, axis_name)
+        if mean:
+            red = red / n
+        off = 0
+        for i in group:
+            leaf = leaves[i]
+            out[i] = red[off:off + leaf.size].reshape(leaf.shape)
+            off += leaf.size
+    return jax.tree.unflatten(treedef, out)
