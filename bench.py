@@ -109,6 +109,20 @@ def lm_geometry():
         grad_bucket_mb=float(os.environ.get("BENCH_GRAD_BUCKET_MB", "0")))
 
 
+
+def health_block(metrics, k: int) -> dict:
+    """Headline-JSON numerical-health block from the fused step probes
+    (obs.health riding the window's metric sums) — shared by both benches
+    so the two JSON schemas cannot drift."""
+    import jax
+
+    hm = jax.device_get({kk: metrics[kk] for kk in
+                         ("grad_norm", "nonfinite_count", "update_norm")})
+    return {"nonfinite_leaves": float(hm["nonfinite_count"]),
+            "grad_norm_per_step": round(float(hm["grad_norm"]) / k, 4),
+            "update_norm_per_step": round(float(hm["update_norm"]) / k, 4)}
+
+
 def lm_build():
     """THE windowed-LM-step builder shared by lm_bench and
     tools/profile_lm.py (the profiler must capture the SAME program the
@@ -289,6 +303,8 @@ def lm_bench():
                         comm_s=None)
     best = max(rates)
     best_phases = phases[rates.index(best)]
+    # the headline carries the last trial's numerical-health block
+    health = health_block(m, k)
     tok_chip = best / n_chips
     tflops = tok_chip * flops_per_token / 1e12
     mfu = tflops / peak if peak else None
@@ -331,6 +347,7 @@ def lm_bench():
         "mfu": round(mfu, 4) if mfu else None,
         "tflops": round(tflops, 2) if tflops else None,
         "phases": best_phases,
+        "health": health,
         "ledger": ledger_path,
     }))
 
@@ -411,7 +428,8 @@ def measure(model_kwargs, per_chip_batch, k, trials):
                        "device_s": round(dt - disp_s, 6)})
     best_phases = phases[rates.index(max(rates))]
     return (max(rates), sorted(rates), step_flops, batch, best_phases,
-            list(zip(rates, phases)))  # trials in timing order, for the ledger
+            list(zip(rates, phases)),  # trials in timing order (ledger)
+            health_block(metrics, k))
 
 
 def main():
@@ -523,7 +541,7 @@ def main():
                 f"ResNet knobs; unset them with BENCH_ARCH={ARCH}")
         kwargs = {}
         default_model = True
-    best, rates, window_flops, batch, phases, trial_data = measure(
+    best, rates, window_flops, batch, phases, trial_data, health = measure(
         kwargs, per_chip_batch, k, trials)
     ips_per_chip, tflops, mfu, fpi = report("headline", best, rates,
                                             window_flops, batch)
@@ -571,6 +589,7 @@ def main():
             "tflops": round(tflops, 2) if tflops else None,
             "flops_per_img": round(fpi) if fpi else None,
             "phases": phases,
+            "health": health,
             "ledger": ledger_path,
         }))
         return
@@ -604,6 +623,7 @@ def main():
         "tflops": round(tflops, 2) if tflops else None,
         "flops_per_img": round(fpi) if fpi else None,
         "phases": phases,
+        "health": health,
         "ledger": ledger_path,
     }))
 

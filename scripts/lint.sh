@@ -3,6 +3,11 @@
 # ledger-schema rule over tests/scripts. Stdlib-only (no jax, no devices),
 # so this runs anywhere — pre-commit, CI, a laptop. Non-zero exit on any
 # unsuppressed finding; suppressions require written reasons by design.
+#
+# DL006 (the absorbed tools/check_ledger_schema) covers every emit site in
+# the union of these two invocations — including the round-9 ones: the
+# health sentry (tpu_dist/obs/health.py), the metrics snapshot
+# (tpu_dist/obs/__init__.py), and the trace-merge/report readers in tools/.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -1,0 +1,244 @@
+"""Numerical-health sentry (obs.health): fused probes, skip/halt policy.
+
+Covers: the probe values and the device-side skip gate at the
+_apply_update level (the one funnel every engine flavor shares), the
+host-side loss-spike EMA detector, and the acceptance NaN-injection
+integration run — a data-driven NaN batch in a real LMTrainer epoch is
+skipped with params bit-identical, data+RNG advancing, exactly one
+``health`` ledger event, and the run still converging; under ``halt`` the
+loop raises and the crash-safe shutdown stamps ``run_end`` as crashed.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_dist.obs.health import (HealthError, HealthSentry, validate_health)
+from tpu_dist.obs.ledger import Ledger, read_ledger
+
+POISON_TOKEN = 3
+SEQ_LEN = 32
+
+
+# ------------------------------------------------------------- unit level
+def _tiny_update_rig():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_dist.engine.state import TrainState
+    from tpu_dist.engine.steps import _apply_update
+
+    tx = optax.sgd(0.1)
+    params = {"w": jnp.arange(4.0), "b": jnp.float32(1.0)}
+    state = TrainState.create(params, {}, tx)
+
+    def run(grads, health):
+        return jax.jit(lambda s, g: _apply_update(tx, s, g, {}, {}, health)
+                       )(state, grads)
+
+    return state, run
+
+
+def test_probes_ride_the_metrics_and_skip_gates_the_update():
+    import jax
+    import jax.numpy as jnp
+
+    state, run = _tiny_update_rig()
+    clean = {"w": jnp.ones(4), "b": jnp.float32(2.0)}
+    new_state, metrics = run(clean, "record")
+    m = jax.device_get(metrics)
+    assert m["nonfinite_count"] == 0
+    assert m["grad_norm"] == pytest.approx(np.sqrt(4 + 4.0), rel=1e-6)
+    assert m["update_norm"] > 0
+    assert not np.allclose(jax.device_get(new_state.params)["w"],
+                           jax.device_get(state.params)["w"])
+
+    poisoned = {"w": jnp.ones(4).at[1].set(jnp.nan), "b": jnp.float32(2.0)}
+    # record: the NaN flows into the params (probes report, nothing gates)
+    bad_state, m = run(poisoned, "record")
+    m = jax.device_get(m)
+    assert m["nonfinite_count"] == 1
+    assert np.isnan(jax.device_get(bad_state.params)["w"]).any()
+    # skip: params/opt bit-identical, step still advances (data+RNG march)
+    skip_state, m = run(poisoned, "skip")
+    m = jax.device_get(m)
+    assert m["nonfinite_count"] == 1
+    before, after = jax.device_get((state.params, skip_state.params))
+    assert all(np.array_equal(before[k], after[k]) for k in before)
+    assert int(jax.device_get(skip_state.step)) == \
+        int(jax.device_get(state.step)) + 1
+
+
+def test_loss_scale_overflow_is_not_a_health_trip():
+    """A dynamic-loss-scale overflow is ROUTINE apex behavior (the finite
+    gate reverts the update and halves the scale) — the probes must
+    report clean zeros for that step, or health=halt would kill every
+    healthy fp16 run at the scale-growth cadence."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_dist.engine.state import TrainState
+    from tpu_dist.engine.steps import _apply_update
+    from tpu_dist.ops.precision import LossScaleState
+
+    tx = optax.sgd(0.1)
+    params = {"w": jnp.arange(4.0)}
+    state = TrainState.create(params, {}, tx, LossScaleState.create(2.0))
+    overflowed = {"w": jnp.ones(4).at[0].set(jnp.inf)}
+    new_state, metrics = jax.jit(
+        lambda s, g: _apply_update(tx, s, g, {}, {}, "halt"))(
+            state, overflowed)
+    m = jax.device_get(metrics)
+    assert m["nonfinite_count"] == 0 and m["grad_norm"] == 0
+    # the ls gate did its own skip: params unchanged, scale halved
+    assert np.array_equal(jax.device_get(new_state.params)["w"],
+                          jax.device_get(state.params)["w"])
+    assert float(jax.device_get(new_state.loss_scale.scale)) == 1.0
+
+
+def test_validate_health_rejects_unknown_policy():
+    validate_health("skip")
+    with pytest.raises(ValueError, match="health"):
+        validate_health("panic")
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    with pytest.raises(ValueError, match="health"):
+        LMTrainer(LMConfig(health="panic"))
+
+
+def test_sentry_loss_spike_and_halt(tmp_path):
+    led = Ledger(str(tmp_path / "h.jsonl"))
+    s = HealthSentry(policy="record", spike_z=4.0, ledger=led, warmup=10)
+    for i in range(30):
+        s.observe(i, 1.0 + 0.01 * (i % 3))
+    assert s.trips == 0
+    s.observe(30, 50.0)  # ~1000 sigma
+    assert s.trips == 1 and s.trips_by_kind == {"loss_spike": 1}
+    s.observe(31, 1.0)   # the spike did not poison the EMA baseline
+    assert s.trips == 1
+    # non-finite loss trips as 'nonfinite' even with zero probe count
+    s.observe(32, float("nan"))
+    assert s.trips_by_kind.get("nonfinite") == 1
+    led.close()
+    recs = [r for r in read_ledger(led.path) if r["event"] == "health"]
+    assert [r["kind"] for r in recs] == ["loss_spike", "nonfinite"]
+    assert recs[0]["action"] == "record" and recs[0]["value"] > 4.0
+
+    halt = HealthSentry(policy="halt", spike_z=4.0, warmup=2)
+    for i in range(5):
+        halt.observe(i, 1.0)
+    with pytest.raises(HealthError, match="loss_spike"):
+        halt.observe(5, 100.0)
+    with pytest.raises(HealthError, match="nonfinite"):
+        halt.observe(6, 1.0, nonfinite=2.0)
+
+
+# ----------------------------------------------- engine integration (CPU)
+class _NaNModel:
+    """Delegating model wrapper that poisons the logits of any batch whose
+    first row is the constant sentinel token — data-driven NaN injection
+    through the real forward/backward, so the step's GRADIENTS go NaN."""
+
+    def __init__(self, inner, token):
+        self._inner = inner
+        self._token = token
+
+    def apply(self, variables, x, *args, **kwargs):
+        import jax.numpy as jnp
+
+        out = self._inner.apply(variables, x, *args, **kwargs)
+        poison = jnp.where(jnp.all(x[0] == self._token),
+                           jnp.float32(jnp.nan), jnp.float32(0.0))
+        if isinstance(out, tuple):
+            return out[0] + poison, out[1]
+        return out + poison
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _poisoned_trainer(tmp_path, health, poison_batch):
+    """Tiny-LM trainer whose epoch-0 batch ``poison_batch`` leads with an
+    all-sentinel row (the corpus itself is edited, so the injection is
+    data-driven end to end)."""
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    cfg = LMConfig(epochs=1, batch_size=8, seq_len=SEQ_LEN, vocab_size=64,
+                   num_layers=1, d_model=32, num_heads=2,
+                   synth_tokens=80 * SEQ_LEN + 1, print_freq=1, seed=0,
+                   health=health,
+                   ledger_path=str(tmp_path / f"{health}.jsonl"))
+    tr = LMTrainer(cfg)
+    idx, _ = tr._epoch_indices(tr.train_ds, True, 0)
+    row = int(idx[poison_batch][0])
+    tr.train_ds.stream[row * SEQ_LEN: (row + 1) * SEQ_LEN + 1] = POISON_TOKEN
+    tr.model = _NaNModel(tr.model, POISON_TOKEN)
+    tr._build_steps()  # rebuild the jitted steps over the wrapped model
+    return tr, cfg
+
+
+def test_health_skip_nan_injection_lm_run(tmp_path):
+    """Acceptance: with health=skip, the NaN-grad step is skipped (params
+    bit-identical, data+RNG advance), the run completes with exactly one
+    'health' ledger event, and the tiny LM still converges."""
+    import jax
+
+    tr, cfg = _poisoned_trainer(tmp_path, "skip", poison_batch=3)
+    seen = {}
+    orig = tr.train_step
+
+    def spy(state, inputs, targets, rng):
+        poisoned = bool(
+            (np.asarray(jax.device_get(inputs))[0] == POISON_TOKEN).all())
+        if poisoned:
+            seen["before"] = jax.device_get(state.params)
+            seen["step_before"] = int(jax.device_get(state.step))
+        out_state, metrics = orig(state, inputs, targets, rng)
+        if poisoned:
+            seen["after"] = jax.device_get(out_state.params)
+            seen["step_after"] = int(jax.device_get(out_state.step))
+        return out_state, metrics
+
+    tr.train_step = spy
+    tr.fit()  # completes — the poisoned batch does not kill the run
+
+    assert "before" in seen, "the poisoned batch never reached the step"
+    flat_b = jax.tree_util.tree_leaves(seen["before"])
+    flat_a = jax.tree_util.tree_leaves(seen["after"])
+    assert all(np.array_equal(b, a) for b, a in zip(flat_b, flat_a)), \
+        "skip must keep params bit-identical across the NaN step"
+    assert seen["step_after"] == seen["step_before"] + 1, \
+        "skip must still advance the step counter (data+RNG lockstep)"
+
+    recs = read_ledger(cfg.ledger_path)
+    trips = [r for r in recs if r["event"] == "health"]
+    assert len(trips) == 1 and trips[0]["kind"] == "nonfinite"
+    assert trips[0]["action"] == "skip" and trips[0]["policy"] == "skip"
+    steps = [r for r in recs if r["event"] == "step"]
+    # the poisoned record carries the trip: NaN loss is None after json-
+    # safety, nonfinite_count == 1; every other record is clean
+    bad = [r for r in steps if (r.get("nonfinite_count") or 0) > 0]
+    assert len(bad) == 1 and bad[0]["loss"] is None
+    losses = [r["loss"] for r in steps if r["loss"] is not None
+              and not r.get("warm")]
+    assert losses[-1] < losses[0], "run should still converge past the skip"
+    (end,) = [r for r in recs if r["event"] == "run_end"]
+    assert end["status"] == "ok" and end["health_trips"] == 1
+    # the epoch averages were not poisoned by the skipped record
+    (ep,) = [r for r in recs if r["event"] == "epoch"]
+    assert ep["loss"] is not None
+
+
+def test_health_halt_nan_injection_raises(tmp_path):
+    """Acceptance twin: health=halt raises out of the loop at the drain
+    that sees the NaN, and the crash-safe shutdown stamps run_end."""
+    tr, cfg = _poisoned_trainer(tmp_path, "halt", poison_batch=1)
+    with pytest.raises(HealthError, match="nonfinite"):
+        tr.fit()
+    recs = read_ledger(cfg.ledger_path)
+    assert [r for r in recs if r["event"] == "health"][0]["action"] == "halt"
+    (end,) = [r for r in recs if r["event"] == "run_end"]
+    assert end["status"] == "crashed" and "HealthError" in end["error"]

@@ -267,6 +267,15 @@ def test_skew_monitor_two_real_processes(tmp_path):
     # each process wrote its OWN ledger file (.pN suffix for non-main)
     assert os.path.exists(os.path.join(outdir, "skew.jsonl"))
     assert os.path.exists(os.path.join(outdir, "skew.p1.jsonl"))
+    # acceptance: the two REAL per-process ledgers merge into one valid
+    # Chrome trace with a lane per process
+    from tools.trace_merge import merge_ledgers
+
+    trace = json.loads(json.dumps(
+        merge_ledgers([os.path.join(outdir, "skew.jsonl"),
+                       os.path.join(outdir, "skew.p1.jsonl")])))
+    assert trace["otherData"]["processes"] == 2
+    assert {e["pid"] for e in trace["traceEvents"]} == {0, 1}
 
 
 # -------------------------------------------------- engine smoke (CPU)
@@ -277,6 +286,10 @@ def _assert_step_records_complete(recs, unit):
         for k in ("data_s", "dispatch_s", "device_s", "mfu", "throughput",
                   "loss"):
             assert r[k] is not None, (k, r)
+        # the fused health probes (obs.health) ride every step record
+        for k in ("grad_norm", "nonfinite_count", "update_norm"):
+            assert r[k] is not None, (k, r)
+        assert r["nonfinite_count"] == 0  # a healthy smoke run
         assert r["unit"] == unit
     assert phase_totals(steps)["dispatch_s"] > 0
     return steps
@@ -288,6 +301,11 @@ def _assert_run_shape(recs):
     assert "compile" in events and "epoch" in events and "eval" in events
     run = recs[0]
     assert run["config"] and run["devices"] and run["mesh"]
+    # crash-safe shutdown: a clean run stamps status=ok, and the registry
+    # snapshot lands just before run_end
+    assert recs[-1]["status"] == "ok"
+    assert events[-2] == "metrics_snapshot"
+    assert recs[-2]["metrics"]["tpu_dist_steps_total"]
 
 
 def test_image_engine_ledger_smoke(tmp_path):
@@ -327,16 +345,52 @@ def test_image_engine_ledger_smoke(tmp_path):
 
 
 def test_lm_engine_ledger_smoke(tmp_path):
-    """Acceptance twin for the LM engine, windowed (K>1) path included."""
+    """Acceptance twin for the LM engine, windowed (K>1) path included —
+    plus the live-metrics acceptance: a curl-equivalent scrape of the
+    Prometheus endpoint DURING the run returns parseable text carrying
+    step throughput, MFU, and the stall/health-trip counters."""
+    import socket
+    import urllib.request
+
     from tpu_dist.configs import LMConfig
     from tpu_dist.engine.lm_loop import LMTrainer
 
     path = str(tmp_path / "lm.jsonl")
-    cfg = LMConfig(epochs=1, batch_size=8, seq_len=32, vocab_size=64,
-                   num_layers=1, d_model=32, num_heads=2, synth_tokens=4096,
-                   print_freq=4, seed=0, steps_per_dispatch=3,
-                   ledger_path=path)
-    LMTrainer(cfg).fit()
+    tr = None
+    for _ in range(5):  # free-port probe is TOCTOU; retry on the rare race
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        cfg = LMConfig(epochs=1, batch_size=8, seq_len=32, vocab_size=64,
+                       num_layers=1, d_model=32, num_heads=2,
+                       synth_tokens=4096, print_freq=4, seed=0,
+                       steps_per_dispatch=3, ledger_path=path,
+                       metrics_port=port)
+        tr = LMTrainer(cfg)
+        if tr.obs.metrics_server is not None:
+            break
+        os.remove(path)  # the lost race left a stale ledger; start clean
+    assert tr.obs.metrics_server is not None
+    scraped = {}
+
+    def scrape_mid_run(rec):
+        # the epoch event lands mid-run (before run_end closes the
+        # endpoint): scrape exactly then, deterministically
+        if rec.get("event") == "epoch" and "text" not in scraped:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                scraped["text"] = r.read().decode()
+
+    tr.obs.ledger.add_sink(scrape_mid_run)
+    tr.fit()
+    from test_metrics import assert_prometheus_parseable
+
+    text = scraped["text"]
+    assert_prometheus_parseable(text)
+    assert "tpu_dist_steps_total" in text and "tpu_dist_mfu" in text
+    assert 'tpu_dist_step_throughput{unit="tok/s"}' in text
+    assert "tpu_dist_stalls_total 0" in text
+    assert 'tpu_dist_health_trips_total{kind="nonfinite"} 0' in text
     recs = read_ledger(path)
     _assert_run_shape(recs)
     steps = _assert_step_records_complete(recs, "tok/s")
@@ -344,6 +398,46 @@ def test_lm_engine_ledger_smoke(tmp_path):
     assert max(r["steps_in_dispatch"] for r in steps) == 3
     (ep,) = [r for r in recs if r["event"] == "epoch"]
     assert ep["unit"] == "tok/s" and ep["ppl"] > 0
+
+
+def test_crash_safe_run_end_stamps_status(tmp_path):
+    """The crash-shutdown satellite: an unhandled exception inside the
+    loop reaches run_end through fit()'s finally with status='crashed'
+    and a truncated traceback — and the line-buffered JSONL means every
+    prior event already survived on disk."""
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    path = str(tmp_path / "crash.jsonl")
+    cfg = LMConfig(epochs=1, batch_size=8, seq_len=32, vocab_size=64,
+                   num_layers=1, d_model=32, num_heads=2, synth_tokens=2048,
+                   print_freq=2, seed=0, ledger_path=path)
+    tr = LMTrainer(cfg)
+
+    def boom(epoch=0):
+        raise RuntimeError("injected mid-run crash")
+
+    tr.validate = boom  # dies after the train epoch, inside fit()
+    with pytest.raises(RuntimeError, match="injected"):
+        tr.fit()
+    recs = read_ledger(path)
+    (end,) = [r for r in recs if r["event"] == "run_end"]
+    assert end["status"] == "crashed"
+    assert "injected mid-run crash" in end["error"]
+    assert [r for r in recs if r["event"] == "step"]  # prior events intact
+    # the guard disarmed cleanly (compare the underlying functions — a
+    # bound method is a fresh object per attribute access, so `is`
+    # against tr.obs._excepthook would be vacuous)
+    import signal as _signal
+    import sys as _sys
+
+    from tpu_dist.obs import RunObs
+
+    assert tr.obs._prev_excepthook is None
+    assert getattr(_sys.excepthook, "__func__", None) \
+        is not RunObs._excepthook
+    assert getattr(_signal.getsignal(_signal.SIGTERM), "__func__", None) \
+        is not RunObs._on_sigterm
 
 
 def test_generate_ledger_decode_event(tmp_path):

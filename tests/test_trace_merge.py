@@ -1,0 +1,142 @@
+"""Cross-process trace merge (tools/trace_merge.py) — no jax needed.
+
+Covers: two synthetic .pN ledgers merging into one valid Chrome-trace
+JSON with a lane per process (phase slices, comm overlay, alert instants,
+skew/hbm counters, metadata names), per-process clock normalization to
+run_start, CLI sibling discovery + output file, and the crash-tolerance
+satellite: a truncated trailing JSONL line is skipped with a warning by
+both trace_merge and ledger_report instead of raising.
+"""
+
+import json
+
+import pytest
+
+from tpu_dist.obs.ledger import read_ledger
+from tools.trace_merge import discover_ledgers, main, merge_ledgers
+
+
+def _write_ledger(path, pid, t0):
+    """Hand-write a schema-conformant ledger with DETERMINISTIC timestamps
+    (Ledger.emit stamps wall time; the merge math needs fixed numbers)."""
+    recs = [
+        {"event": "run_start", "ts": t0, "pid": pid, "kind": "lm",
+         "config": {}, "mesh": {"data": 2}, "devices": ["cpu"],
+         "process_count": 2},
+        {"event": "compile", "ts": t0 + 1.0, "pid": pid,
+         "program": "train_step"},
+        {"event": "step", "ts": t0 + 2.0, "pid": pid, "step": 0, "loss": 2.0,
+         "throughput": 1000.0, "unit": "tok/s", "data_s": 0.1,
+         "dispatch_s": 0.2, "device_s": 0.5, "comm_s": 0.2, "mfu": 0.5,
+         "steps_in_dispatch": 1},
+        {"event": "skew", "ts": t0 + 2.5, "pid": pid, "step": 0,
+         "p50_s": 0.1, "p99_s": 0.2, "spread_s": 0.01 * (pid + 1),
+         "straggler": 1},
+        {"event": "health", "ts": t0 + 2.6, "pid": pid, "step": 1,
+         "kind": "nonfinite", "policy": "skip", "action": "skip",
+         "value": 3.0},
+        {"event": "stall", "ts": t0 + 2.7, "pid": pid, "idle_s": 9.0,
+         "threshold_s": 5.0, "stacks": "..."},
+        {"event": "hbm", "ts": t0 + 2.8, "pid": pid, "bytes_in_use": 1024},
+        {"event": "eval", "ts": t0 + 3.0, "pid": pid, "epoch": 0,
+         "loss": 1.5},
+        {"event": "epoch", "ts": t0 + 3.5, "pid": pid, "epoch": 0,
+         "start_ts": t0 + 1.0, "seconds": 2.5, "throughput": 900.0,
+         "unit": "tok/s", "loss": 1.8},
+        {"event": "run_end", "ts": t0 + 4.0, "pid": pid, "steps": 1,
+         "seconds": 4.0, "status": "ok"},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return recs
+
+
+def test_merge_two_process_ledgers(tmp_path):
+    base = str(tmp_path / "run.jsonl")
+    sib = str(tmp_path / "run.p1.jsonl")
+    # process clocks deliberately offset by 100s: normalization per
+    # run_start must line both lanes up near t=0
+    _write_ledger(base, 0, t0=1000.0)
+    _write_ledger(sib, 1, t0=1100.0)
+
+    assert discover_ledgers(base) == [base, sib]
+    trace = merge_ledgers([base, sib])
+    txt = json.dumps(trace)       # valid JSON end to end
+    trace = json.loads(txt)
+    ev = trace["traceEvents"]
+    assert trace["otherData"]["processes"] == 2
+    pids = {e["pid"] for e in ev}
+    assert pids == {0, 1}
+
+    for pid in (0, 1):
+        lane = [e for e in ev if e["pid"] == pid]
+        names = {e["name"] for e in lane}
+        # phase slices, overlays, instants, counters, metadata all present
+        assert {"data", "dispatch", "device", "comm"} <= names
+        assert "STALL" in names and "health:nonfinite" in names
+        assert "skew spread (ms)" in names and "hbm bytes" in names
+        assert any(e["ph"] == "M" and e["name"] == "process_name"
+                   for e in lane)
+        # clock normalized to the process's OWN run_start: everything in
+        # the first handful of seconds, never at the 100s wall offset
+        times = [e["ts"] for e in lane if "ts" in e]
+        assert min(times) >= 0 and max(times) < 10e6
+        # the step's three slices are back-to-back and end at the emit ts
+        dev = [e for e in lane if e["name"] == "device"][0]
+        assert dev["ts"] + dev["dur"] == pytest.approx(2.0e6, abs=1)
+        comm = [e for e in lane if e["name"] == "comm"][0]
+        assert comm["ts"] == pytest.approx(dev["ts"])
+        ep = [e for e in lane if e["name"] == "epoch 0"][0]
+        assert ep["dur"] == pytest.approx(2.5e6)
+
+
+def test_cli_discovers_siblings_and_writes_trace(tmp_path, capsys):
+    base = str(tmp_path / "run.jsonl")
+    _write_ledger(base, 0, t0=0.0)
+    _write_ledger(str(tmp_path / "run.p1.jsonl"), 1, t0=0.0)
+    out = str(tmp_path / "merged.json")
+    assert main([base, "-o", out]) == 0
+    assert "2 process lane(s)" in capsys.readouterr().out
+    with open(out) as f:
+        trace = json.load(f)
+    assert {e["pid"] for e in trace["traceEvents"]} == {0, 1}
+
+
+def test_truncated_trailing_line_is_skipped_with_warning(tmp_path, capsys):
+    """The crash satellite: a writer killed mid-write leaves a torn final
+    line; the tolerant readers (trace_merge, ledger_report) must keep
+    every intact record and warn instead of raising."""
+    base = str(tmp_path / "run.jsonl")
+    recs = _write_ledger(base, 0, t0=0.0)
+    with open(base, "a") as f:
+        f.write('{"event": "step", "ts": 99.0, "pid": 0, "loss"')  # torn
+    with pytest.raises(Exception):
+        read_ledger(base)  # strict default still raises (engine contract)
+    kept = read_ledger(base, strict=False)
+    assert len(kept) == len(recs)
+    assert "skipping corrupt/truncated" in capsys.readouterr().err
+
+    trace = merge_ledgers([base])
+    assert trace["otherData"]["processes"] == 1
+    # ledger_report's CLI path reads tolerantly too and renders health
+    from tools.ledger_report import main as report_main, summarize
+
+    lines = []
+    counts = summarize(kept, out=lines.append)
+    assert counts["health"] == 1
+    assert any("HEALTH TRIPS: 1" in ln for ln in lines)
+    assert report_main([base]) == 0
+    capsys.readouterr()
+
+
+def test_unknown_future_event_skipped_not_fatal(tmp_path):
+    """A ledger written by a NEWER tpu_dist (an event this tree does not
+    declare) merges with a warning — operators debug across versions."""
+    base = str(tmp_path / "run.jsonl")
+    _write_ledger(base, 0, t0=0.0)
+    with open(base, "a") as f:
+        f.write(json.dumps({"event": "from_the_future", "ts": 5.0,
+                            "pid": 0}) + "\n")
+    trace = merge_ledgers([base])
+    assert trace["otherData"]["processes"] == 1

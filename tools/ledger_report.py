@@ -7,9 +7,12 @@
 Renders: run identity (kind/mesh/devices/processes), per-phase time share
 (data wait vs dispatch vs device block across every step record), MFU and
 throughput trend (first/middle/last thirds), the epoch table, cross-host
-skew/straggler summary, and any watchdog stall dumps. Pure stdlib + the
-ledger module — safe to run on a login host with no jax installed
-(obs.ledger imports nothing heavy).
+skew/straggler summary, numerical-health trips (obs.health), and any
+watchdog stall dumps; multi-process runs get a pointer at the merged
+Chrome trace (tools/trace_merge.py). Corrupt/truncated trailing lines —
+crashed runs are exactly the ones inspected here — are skipped with a
+warning, never a crash. Pure stdlib + the ledger module — safe to run on
+a login host with no jax installed (obs.ledger imports nothing heavy).
 """
 
 import argparse
@@ -52,6 +55,7 @@ def summarize(records, out=print):
     skews = [r for r in records if r["event"] == "skew"
              and r.get("spread_s") is not None]
     stalls = [r for r in records if r["event"] == "stall"]
+    healths = [r for r in records if r["event"] == "health"]
     ends = [r for r in records if r["event"] == "run_end"]
 
     for r in runs:
@@ -60,10 +64,18 @@ def summarize(records, out=print):
             + (" (MFU vs NOMINAL peak)" if r.get("peak_is_nominal") else ""))
     if ends:
         secs = ends[-1]["seconds"]
-        out(f"completed: {ends[-1]['steps']} steps in "
+        status = ends[-1].get("status") or "ok"
+        out(f"{'CRASHED' if status == 'crashed' else 'completed'}: "
+            f"{ends[-1]['steps']} steps in "
             + (f"{secs:.1f}s" if secs is not None else "?s")
             + "".join(f" {k}={v}" for k, v in ends[-1].items()
-                      if k not in ("event", "ts", "pid", "steps", "seconds")))
+                      if k not in ("event", "ts", "pid", "steps", "seconds",
+                                   "error", "metrics"))
+            + (f"\n  error: {ends[-1]['error'].strip().splitlines()[-1]}"
+               if ends[-1].get("error") else ""))
+    elif records:
+        out("NO run_end record: the writer died mid-run (crash/SIGKILL) — "
+            "the events below are everything that reached disk")
 
     if steps:
         # warm records carry the XLA compile in dispatch_s; exclude them
@@ -129,6 +141,18 @@ def summarize(records, out=print):
             f"p50 {worst['p50_s'] * 1e3:.1f}ms p99 {worst['p99_s'] * 1e3:.1f}ms")
         out(f"straggler histogram (process: samples): {hist}")
 
+    if healths:
+        kinds = {}
+        for r in healths:
+            kinds[r.get("kind")] = kinds.get(r.get("kind"), 0) + 1
+        out(f"\nHEALTH TRIPS: {len(healths)} "
+            f"({', '.join(f'{k}: {n}' for k, n in sorted(kinds.items()))}; "
+            f"policy {healths[-1].get('policy')})")
+        for r in healths[-5:]:
+            out(f"  step {r.get('step')}: {r.get('kind')} "
+                f"value={r.get('value')} loss={r.get('loss')} "
+                f"-> {r.get('action')}")
+
     if stalls:
         out(f"\nWATCHDOG STALLS: {len(stalls)}")
         for r in stalls:
@@ -137,7 +161,7 @@ def summarize(records, out=print):
             for line in (r.get("stacks") or "").splitlines()[:6]:
                 out(f"    {line}")
     return {"steps": len(steps), "epochs": len(epochs), "skews": len(skews),
-            "stalls": len(stalls)}
+            "stalls": len(stalls), "health": len(healths)}
 
 
 def main(argv=None) -> int:
@@ -146,7 +170,9 @@ def main(argv=None) -> int:
     ap.add_argument("--tail", type=int, default=0,
                     help="also render the last N step records as lines")
     args = ap.parse_args(argv)
-    records = read_ledger(args.path)
+    # strict=False: a crashed writer leaves a torn trailing line, and a
+    # crashed run is exactly the one being inspected — warn, don't raise
+    records = read_ledger(args.path, strict=False)
     if not records:
         print(f"{args.path}: empty ledger", file=sys.stderr)
         return 1
@@ -156,6 +182,13 @@ def main(argv=None) -> int:
         sink = ProgressSink()
         for r in [r for r in records if r["event"] == "step"][-args.tail:]:
             sink(r)
+    import glob
+
+    root, ext = os.path.splitext(args.path)
+    if glob.glob(f"{glob.escape(root)}.p*{ext}"):
+        print(f"\nper-process sibling ledgers found — merge the lanes into "
+              f"one Chrome trace with: python tools/trace_merge.py "
+              f"{args.path}")
     return 0
 
 

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Merge a run's per-process ledgers into one Chrome/Perfetto trace.
+
+    python tools/trace_merge.py run.jsonl                 # + run.p*.jsonl
+    python tools/trace_merge.py run.jsonl -o trace.json
+    python tools/trace_merge.py a.jsonl b.p1.jsonl --no-discover
+
+A multi-host run writes one ledger per process (``run.jsonl``,
+``run.p1.jsonl``, ... — obs.ledger.per_process_path); each file is a
+correct per-process timeline, but a straggler or a lopsided eval only
+shows when the lanes sit side by side. This tool merges every sibling
+ledger into one ``trace.json`` in the Chrome trace-event format, loadable
+in ``chrome://tracing`` / https://ui.perfetto.dev:
+
+* one **process lane per ledger** (pid = the ledger's process index),
+  with named thread rows: ``steps`` (the data/dispatch/device slices of
+  every step record, laid back-to-back ending at the record's emit time,
+  plus decode calls), ``comm`` (the overlapped comm_s share beside its
+  device slice), ``phases`` (epoch spans, eval/ckpt markers) and
+  ``alerts`` (watchdog stalls, health trips);
+* **counter tracks** for skew spread and HBM-in-use, so a straggler
+  reads as a rising curve, not a grep;
+* clocks are normalized per process to its own ``run_start`` timestamp
+  (the distributed-init barrier aligns the processes' run starts far
+  tighter than wall clocks agree across hosts; the residual offset is
+  visible in the ``skew`` counter track, which records the measured
+  cross-host spread in-band).
+
+Corrupt or truncated trailing lines — the signature of a crashed writer —
+are skipped with a warning (``read_ledger(strict=False)``): crashed runs
+are exactly the ones operators inspect. Pure stdlib + obs.ledger; no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_dist.obs.ledger import read_ledger  # noqa: E402
+
+# thread-row ids within each process lane (Chrome wants ints; names are
+# attached via thread_name metadata events)
+TID_STEPS, TID_COMM, TID_PHASES, TID_ALERTS = 0, 1, 2, 3
+_TID_NAMES = {TID_STEPS: "steps", TID_COMM: "comm (overlaps device)",
+              TID_PHASES: "phases", TID_ALERTS: "alerts"}
+
+
+def discover_ledgers(path: str) -> list:
+    """``run.jsonl`` -> [run.jsonl, run.p1.jsonl, run.p2.jsonl, ...]."""
+    root, ext = os.path.splitext(path)
+    sibs = sorted(glob.glob(f"{glob.escape(root)}.p*{ext}"),
+                  key=lambda p: _pidx_from_name(p, root, ext))
+    return [path] + sibs
+
+
+def _pidx_from_name(path: str, root: str, ext: str) -> int:
+    tag = path[len(root) + 2: len(path) - len(ext)]
+    return int(tag) if tag.isdigit() else 0
+
+
+def _args(rec: dict, keys) -> dict:
+    return {k: rec[k] for k in keys if rec.get(k) is not None}
+
+
+def _process_events(records: list, pid: int) -> list:
+    """One ledger's records -> Chrome trace events (ts/dur in µs, offset
+    to the process's own run_start)."""
+    starts = [r["ts"] for r in records if r.get("event") == "run_start"]
+    t0 = starts[0] if starts else (records[0]["ts"] if records else 0.0)
+    us = lambda ts: max((ts - t0) * 1e6, 0.0)
+    ev: list = []
+    name = None
+    for r in records:
+        if r.get("event") == "run_start":
+            name = f"process {pid}" + (
+                f" ({'/'.join(r['devices'])})" if r.get("devices") else "")
+    ev.append({"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+               "args": {"name": name or f"process {pid}"}})
+    for tid, tname in _TID_NAMES.items():
+        ev.append({"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                   "args": {"name": tname}})
+
+    for r in records:
+        kind, ts = r.get("event"), r.get("ts", t0)
+        if kind == "step":
+            phases = [(p, r.get(f"{p}_s") or 0.0)
+                      for p in ("data", "dispatch", "device")]
+            end = us(ts)
+            start = end - sum(d for _, d in phases) * 1e6
+            meta = _args(r, ("step", "loss", "mfu", "throughput", "unit",
+                             "steps_in_dispatch", "grad_norm",
+                             "nonfinite_count", "warm"))
+            cursor = start
+            for pname, dur in phases:
+                if dur <= 0:
+                    continue
+                ev.append({"ph": "X", "name": pname, "pid": pid,
+                           "tid": TID_STEPS, "ts": cursor, "dur": dur * 1e6,
+                           "args": meta})
+                if pname == "device" and r.get("comm_s"):
+                    # comm OVERLAPS the device block (obs.ledger schema
+                    # note) — its own row, aligned under the device slice
+                    ev.append({"ph": "X", "name": "comm", "pid": pid,
+                               "tid": TID_COMM, "ts": cursor,
+                               "dur": min(r["comm_s"], dur) * 1e6,
+                               "args": {"comm_s": r["comm_s"]}})
+                cursor += dur * 1e6
+        elif kind == "epoch":
+            start = r.get("start_ts")
+            dur = r.get("seconds")
+            if start is not None and dur:
+                ev.append({"ph": "X", "name": f"epoch {r.get('epoch')}",
+                           "pid": pid, "tid": TID_PHASES, "ts": us(start),
+                           "dur": dur * 1e6,
+                           "args": _args(r, ("loss", "throughput", "unit"))})
+        elif kind == "decode":
+            dur = r.get("seconds") or 0.0
+            ev.append({"ph": "X", "name": "decode", "pid": pid,
+                       "tid": TID_STEPS, "ts": us(ts) - dur * 1e6,
+                       "dur": dur * 1e6,
+                       "args": _args(r, ("tokens", "throughput", "cached"))})
+        elif kind in ("eval", "ckpt", "compile", "run_start", "run_end"):
+            ev.append({"ph": "i", "name": kind, "pid": pid,
+                       "tid": TID_PHASES, "ts": us(ts), "s": "t",
+                       "args": _args(r, ("epoch", "loss", "ppl", "acc1",
+                                         "path", "program", "status",
+                                         "steps"))})
+        elif kind == "stall":
+            ev.append({"ph": "i", "name": "STALL", "pid": pid,
+                       "tid": TID_ALERTS, "ts": us(ts), "s": "g",
+                       "args": _args(r, ("idle_s", "threshold_s"))})
+        elif kind == "health":
+            ev.append({"ph": "i", "name": f"health:{r.get('kind')}",
+                       "pid": pid, "tid": TID_ALERTS, "ts": us(ts),
+                       "s": "g",
+                       "args": _args(r, ("step", "policy", "action",
+                                         "value", "loss"))})
+        elif kind == "skew":
+            ev.append({"ph": "C", "name": "skew spread (ms)", "pid": pid,
+                       "ts": us(ts),
+                       "args": {"spread": (r.get("spread_s") or 0) * 1e3}})
+        elif kind == "hbm":
+            ev.append({"ph": "C", "name": "hbm bytes", "pid": pid,
+                       "ts": us(ts),
+                       "args": {"in_use": r.get("bytes_in_use") or 0}})
+    return ev
+
+
+def merge_ledgers(paths: list) -> dict:
+    """Paths -> the Chrome trace object ({"traceEvents": [...], ...})."""
+    events: list = []
+    lanes = 0
+    for i, p in enumerate(paths):
+        try:
+            records = read_ledger(p, strict=False)
+        except OSError as e:
+            print(f"warning: skipping {p}: {e}", file=sys.stderr)
+            continue
+        if not records:
+            print(f"warning: {p}: no readable records", file=sys.stderr)
+            continue
+        pid = records[0].get("pid", i)
+        events.extend(_process_events(records, pid))
+        lanes += 1
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"tool": "tpu_dist tools/trace_merge.py",
+                          "processes": lanes,
+                          "clock": "per-process, zeroed at run_start"}}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="ledger JSONL path(s); the first path's .pN "
+                         "siblings are auto-discovered")
+    ap.add_argument("-o", "--out", default="",
+                    help="output path (default: <first ledger>.trace.json)")
+    ap.add_argument("--no-discover", action="store_true",
+                    help="merge only the paths given (no .pN glob)")
+    args = ap.parse_args(argv)
+    paths = list(args.paths)
+    if not args.no_discover:
+        for sib in discover_ledgers(paths[0])[1:]:
+            if sib not in paths:
+                paths.append(sib)
+    trace = merge_ledgers(paths)
+    if not trace["traceEvents"]:
+        print("no records in any input ledger", file=sys.stderr)
+        return 1
+    out = args.out or (os.path.splitext(paths[0])[0] + ".trace.json")
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"{out}: {trace['otherData']['processes']} process lane(s), "
+          f"{len(trace['traceEvents'])} events — load in chrome://tracing "
+          "or ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -138,6 +138,16 @@ class TrainConfig:
                                        # (5s floor; 0 disables)
     skew_every: int = 0                # cross-host step-time skew allgather
                                        # every K steps (obs.skew; 0 = off)
+    health: str = "record"             # numerical-health policy (obs.health):
+                                       # record (probes + ledger events only)
+                                       # | skip (zero a non-finite update,
+                                       # advance data+RNG — multi-host
+                                       # lockstep preserved) | halt (raise)
+    health_spike_z: float = 8.0        # loss-spike z-score threshold of the
+                                       # host-side EMA detector (0 disables)
+    metrics_port: int = 0              # Prometheus scrape endpoint
+                                       # (obs.metrics): process i serves
+                                       # http://host:(port+i)/metrics; 0=off
 
     # -- synthetic-data knobs (TPU-only: zero-egress envs can't download datasets)
     synth_train_size: int = 50000
@@ -274,6 +284,12 @@ class LMConfig:
     watchdog_factor: float = 10.0  # hang watchdog: factor x trailing-median
                                    # step time (5s floor; 0 disables)
     skew_every: int = 0            # cross-host skew allgather every K steps
+    health: str = "record"         # numerical-health policy (obs.health):
+                                   # record | skip (zero a non-finite
+                                   # update, advance data+RNG) | halt
+    health_spike_z: float = 8.0    # loss-spike z-score threshold (0 = off)
+    metrics_port: int = 0          # Prometheus scrape endpoint: process i
+                                   # serves port+i (obs.metrics; 0 = off)
 
 
 def add_args(parser: argparse.ArgumentParser, defaults) -> None:

@@ -63,6 +63,8 @@ class LMTrainer:
             # the SGD update form)
             raise ValueError(f"unknown optimizer {cfg.optimizer!r} "
                              "(sgd|adamw|fused_adamw)")
+        from tpu_dist.obs.health import validate_health
+        validate_health(cfg.health)  # record | skip | halt, before any build
         mesh_shape = cfg.mesh_shape or (jax.device_count(),)
         self.mesh = mesh if mesh is not None else make_mesh(
             tuple(mesh_shape), tuple(cfg.mesh_axes))
@@ -227,7 +229,7 @@ class LMTrainer:
                 make_lm_grad_accum_train_step)
             self.train_step = make_lm_grad_accum_train_step(
                 self.model, self.tx, self.mesh, loss_chunk=cfg.loss_chunk,
-                aux_weight=cfg.moe_aux_weight)
+                aux_weight=cfg.moe_aux_weight, health=cfg.health)
         rows_bytes = (len(self.train_ds) + len(self.val_ds)) * \
             (cfg.seq_len + 1) * 4
         fits = rows_bytes <= int(os.environ.get("TPU_DIST_DEVICE_DATA_MAX",
@@ -254,7 +256,7 @@ class LMTrainer:
                     self.model, self.tx, self.mesh, cfg.pp_microbatches,
                     schedule=cfg.pp_schedule, loss_chunk=cfg.loss_chunk,
                     aux_weight=cfg.moe_aux_weight,
-                    grad_clip=cfg.grad_clip)
+                    grad_clip=cfg.grad_clip, health=cfg.health)
                 self.window_eval_step = make_lm_pp_indexed_eval_step(
                     self.model, self.mesh, cfg.pp_microbatches,
                     loss_chunk=cfg.loss_chunk)
@@ -265,7 +267,7 @@ class LMTrainer:
                 self.window_step = make_lm_sp_indexed_multi_train_step(
                     self._sp_ctor, self.tx, self.mesh,
                     loss_chunk=cfg.loss_chunk,
-                    aux_weight=cfg.moe_aux_weight)
+                    aux_weight=cfg.moe_aux_weight, health=cfg.health)
                 self.window_eval_step = make_lm_sp_indexed_eval_step(
                     self._sp_ctor, self.mesh, loss_chunk=cfg.loss_chunk)
             elif self.use_ring or self.use_bucket:
@@ -282,7 +284,7 @@ class LMTrainer:
                 self.window_step = make_lm_indexed_multi_train_step(
                     self.model, self.tx, self.mesh,
                     loss_chunk=cfg.loss_chunk,
-                    aux_weight=cfg.moe_aux_weight)
+                    aux_weight=cfg.moe_aux_weight, health=cfg.health)
                 self.window_eval_step = make_lm_indexed_eval_step(
                     self.model, self.mesh, loss_chunk=cfg.loss_chunk)
         elif self.k > 1:
@@ -473,7 +475,7 @@ class LMTrainer:
             self.train_step = maker(
                 self.model, self.tx, self.mesh, cfg.pp_microbatches,
                 loss_chunk=cfg.loss_chunk, aux_weight=cfg.moe_aux_weight,
-                grad_clip=cfg.grad_clip)
+                grad_clip=cfg.grad_clip, health=cfg.health)
             self.eval_step = make_lm_pp_eval_step(
                 self.model, self.mesh, cfg.pp_microbatches,
                 loss_chunk=cfg.loss_chunk)
@@ -489,7 +491,7 @@ class LMTrainer:
             self._sp_ctor = ctor  # the windowed sp steps rebind it per-axis
             self.train_step = make_lm_sp_train_step(
                 ctor, self.tx, self.mesh, loss_chunk=cfg.loss_chunk,
-                aux_weight=cfg.moe_aux_weight)
+                aux_weight=cfg.moe_aux_weight, health=cfg.health)
             self.eval_step = make_lm_sp_eval_step(
                 ctor, self.mesh, loss_chunk=cfg.loss_chunk)
             self.data_spec = P("data", "seq")
@@ -506,10 +508,11 @@ class LMTrainer:
             self._explicit_step_fn = _lm_tp_ring_step_fn(
                 self._ring_model, self.tx, cfg.moe_aux_weight, "data",
                 "model", self.mesh.shape["model"],
-                loss_chunk=cfg.loss_chunk)
+                loss_chunk=cfg.loss_chunk, health=cfg.health)
             self.train_step = make_lm_tp_ring_train_step(
                 self._ring_model, self.tx, self.mesh,
-                loss_chunk=cfg.loss_chunk, aux_weight=cfg.moe_aux_weight)
+                loss_chunk=cfg.loss_chunk, aux_weight=cfg.moe_aux_weight,
+                health=cfg.health)
             self.eval_step = make_lm_eval_step(
                 self.model, self.mesh, loss_chunk=cfg.loss_chunk)
             self.data_spec = P("data")
@@ -522,11 +525,12 @@ class LMTrainer:
             self._explicit_step_fn = _lm_explicit_dp_step_fn(
                 self.model, self.tx, cfg.moe_aux_weight, "data",
                 self.mesh.shape["data"], cfg.grad_bucket_mb,
-                loss_chunk=cfg.loss_chunk)
+                loss_chunk=cfg.loss_chunk, health=cfg.health)
             self.train_step = make_lm_shard_map_train_step(
                 self.model, self.tx, self.mesh,
                 grad_bucket_mb=cfg.grad_bucket_mb,
-                loss_chunk=cfg.loss_chunk, aux_weight=cfg.moe_aux_weight)
+                loss_chunk=cfg.loss_chunk, aux_weight=cfg.moe_aux_weight,
+                health=cfg.health)
             self.eval_step = make_lm_eval_step(
                 self.model, self.mesh, loss_chunk=cfg.loss_chunk)
             self.data_spec = P("data")
@@ -534,7 +538,7 @@ class LMTrainer:
         else:
             self.train_step = make_lm_train_step(
                 self.model, self.tx, self.mesh, loss_chunk=cfg.loss_chunk,
-                aux_weight=cfg.moe_aux_weight)
+                aux_weight=cfg.moe_aux_weight, health=cfg.health)
             self.eval_step = make_lm_eval_step(
                 self.model, self.mesh, loss_chunk=cfg.loss_chunk)
             self.data_spec = P("data")
@@ -616,7 +620,13 @@ class LMTrainer:
     def _drain(self, pending, meters) -> None:
         """One blocking transfer per print window (the async-dispatch sync
         point), then one ledger ``step`` record per drained entry with the
-        transfer's device-block time apportioned across the window."""
+        transfer's device-block time apportioned across the window. The
+        fused health probes ride the same fetch (obs.health): under
+        ``skip`` a non-finite record stays out of the meter averages (its
+        update was already zeroed on device), and under ``halt`` the
+        sentry raises out of the loop."""
+        import math
+
         with self.obs.tracer.span("device"):
             fetched = jax.device_get([m for m, _ in pending])
         device_s = self.obs.tracer.pop().get("device", 0.0)
@@ -626,8 +636,13 @@ class LMTrainer:
         for m, (_, info) in zip(fetched, pending):
             cnt = float(m["count"])
             loss = float(m["loss_sum"]) / cnt
-            meters.update("Loss", loss, int(cnt))
-            meters.update("Acc", float(m["correct1"]) / cnt, int(cnt))
+            # under 'skip' the non-finite step's update was zeroed on
+            # device, so its NaN loss must not poison the epoch averages;
+            # under 'record'/'halt' the NaN flows through — divergence
+            # should be VISIBLE in the printed loss, as it always was
+            if math.isfinite(loss) or self.obs.health.policy != "skip":
+                meters.update("Loss", loss, int(cnt))
+                meters.update("Acc", float(m["correct1"]) / cnt, int(cnt))
             # MoE router health: mean per-token combine mass (1.0 = no
             # capacity drops; the dropped fraction is ~(1 - RMass) for
             # top-2, and (1 - RMass/avg_gate) for top-1)
@@ -635,18 +650,25 @@ class LMTrainer:
             if n > 0:
                 meters.update("RMass", float(m["router_mass_sum"]) / n,
                               int(n))
-            share = device_s * info["n_steps"] / total_steps
+            k = info["n_steps"]
+            share = device_s * k / total_steps
+            gn = float(m["grad_norm"]) / k
+            nf = float(m["nonfinite_count"])
+            un = float(m["update_norm"]) / k
             self.obs.step(
                 info["step"], loss, info["n_items"],
                 wall_s=info["data_s"] + info["dispatch_s"] + share,
                 data_s=info["data_s"], dispatch_s=info["dispatch_s"],
                 device_s=share, device_flops=self._device_step_flops(),
-                steps_in_dispatch=info["n_steps"],
+                steps_in_dispatch=k,
                 warm=info.get("warm", False),
-                comm_s=(self._comm_probe_s * info["n_steps"]
+                comm_s=(self._comm_probe_s * k
                         if self._comm_probe_s else None),
+                grad_norm=gn, nonfinite_count=nf, update_norm=un,
                 hbm_bytes_in_use=hbm.get("bytes_in_use"),
                 hbm_peak_bytes=hbm.get("peak_bytes_in_use"))
+            self.obs.health.observe(info["step"], loss, nonfinite=nf,
+                                    grad_norm=gn, update_norm=un, n_steps=k)
         pending.clear()
         self.obs.heartbeat()  # watchdog: device progress proven at this sync
 

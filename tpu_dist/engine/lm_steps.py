@@ -160,7 +160,8 @@ def _lm_grads_and_metrics(model, aux_weight: float, params, inputs, targets,
     return grads, metrics
 
 
-def _lm_step_fn(model, tx, aux_weight: float, loss_chunk: int = 0) -> Callable:
+def _lm_step_fn(model, tx, aux_weight: float, loss_chunk: int = 0,
+                health: str = "record") -> Callable:
     """THE pure LM train step shared by every jit wrapper (single-batch and
     indexed-window) — the lm twin of steps.py _train_step_fn, so the
     windowed path's 'identical math to K sequential steps' contract is
@@ -171,14 +172,15 @@ def _lm_step_fn(model, tx, aux_weight: float, loss_chunk: int = 0) -> Callable:
         grads, metrics = _lm_grads_and_metrics(
             model, aux_weight, state.params, inputs, targets, dropout_rng,
             loss_chunk)
-        return _apply_update(tx, state, grads, {}, metrics)
+        return _apply_update(tx, state, grads, {}, metrics, health)
 
     return step
 
 
 def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
                        aux_weight: float = 0.01,
-                       donate: bool = True, loss_chunk: int = 0) -> Callable:
+                       donate: bool = True, loss_chunk: int = 0,
+                       health: str = "record") -> Callable:
     """jit step for DP — and for DP x TP / FSDP / EP when the TrainState was
     placed with the matching sharding helper (GSPMD propagates the param
     layout and emits the collectives; the step code is identical).
@@ -189,7 +191,7 @@ def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
     # With TP the state arrives pre-sharded (tpu_dist.parallel.tp.shard_lm_params)
     # and in_shardings=None lets GSPMD propagate that layout through the step;
     # pure DP states arrive replicated — same jit serves both.
-    return jax.jit(_lm_step_fn(model, tx, aux_weight, loss_chunk),
+    return jax.jit(_lm_step_fn(model, tx, aux_weight, loss_chunk, health),
                    in_shardings=(None, batch_sh, batch_sh, repl),
                    out_shardings=None,
                    donate_argnums=(0,) if donate else ())
@@ -199,7 +201,8 @@ def make_lm_grad_accum_train_step(model, tx, mesh: Mesh,
                                   data_axis: str = DATA_AXIS,
                                   aux_weight: float = 0.01,
                                   donate: bool = True,
-                                  loss_chunk: int = 0) -> Callable:
+                                  loss_chunk: int = 0,
+                                  health: str = "record") -> Callable:
     """ONE optimizer step from K microbatches (gradient accumulation), the
     LM twin of steps.py make_grad_accum_train_step.
 
@@ -231,7 +234,7 @@ def make_lm_grad_accum_train_step(model, tx, mesh: Mesh,
         (grads, _), metrics_k = jax.lax.scan(
             micro, (zeros, jnp.int32(0)), (inputs, targets))
         metrics = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
-        return _apply_update(tx, state, grads, {}, metrics)
+        return _apply_update(tx, state, grads, {}, metrics, health)
 
     return jax.jit(step, in_shardings=(None, batch_sh, batch_sh, repl),
                    out_shardings=(None, repl),
@@ -242,7 +245,8 @@ def make_lm_grad_accum_train_step(model, tx, mesh: Mesh,
 
 def _lm_explicit_dp_step_fn(model, tx, aux_weight: float, data_axis: str,
                             axis_size: int, grad_bucket_mb: float,
-                            loss_chunk: int = 0) -> Callable:
+                            loss_chunk: int = 0,
+                            health: str = "record") -> Callable:
     """Per-device dp step with EXPLICIT gradient sync: local-batch grads,
     then either one monolithic per-leaf pmean (bucket_mb <= 0) or DDP-style
     bucketed reduce-scatter+all-gather collectives
@@ -261,14 +265,15 @@ def _lm_explicit_dp_step_fn(model, tx, aux_weight: float, data_axis: str,
         else:
             grads = jax.tree.map(lambda g: jax.lax.pmean(g, data_axis), grads)
         metrics = jax.tree.map(lambda m: jax.lax.psum(m, data_axis), metrics)
-        return _apply_update(tx, state, grads, {}, metrics)
+        return _apply_update(tx, state, grads, {}, metrics, health)
 
     return step
 
 
 def _lm_tp_ring_step_fn(model, tx, aux_weight: float, data_axis: str,
                         model_axis: str, n_model: int,
-                        loss_chunk: int = 0) -> Callable:
+                        loss_chunk: int = 0,
+                        health: str = "record") -> Callable:
     """Per-device dp x ring-TP step: ``model`` must be built with
     tp_impl='ring' (parallel.overlap), so its projections run the
     AG-matmul / matmul-RS collective matmuls over ``model_axis`` and its
@@ -309,7 +314,7 @@ def _lm_tp_ring_step_fn(model, tx, aux_weight: float, data_axis: str,
         metrics = jax.tree.map(
             lambda m: jax.lax.psum(jax.lax.psum(m, model_axis), data_axis),
             metrics)
-        return _apply_update(tx, state, grads, {}, metrics)
+        return _apply_update(tx, state, grads, {}, metrics, health)
 
     return step
 
@@ -332,7 +337,8 @@ def make_lm_shard_map_train_step(model, tx, mesh: Mesh,
                                  aux_weight: float = 0.01,
                                  grad_bucket_mb: float = 25.0,
                                  donate: bool = True,
-                                 loss_chunk: int = 0) -> Callable:
+                                 loss_chunk: int = 0,
+                                 health: str = "record") -> Callable:
     """Explicit-collective dp LM step — the LM twin of steps.py
     make_shard_map_train_step, carrying the ``grad_bucket_mb`` knob:
     gradient sync as independent ~25MB bucket reduce-scatters (DDP's
@@ -340,7 +346,7 @@ def make_lm_shard_map_train_step(model, tx, mesh: Mesh,
     GSPMD would emit. bucket_mb <= 0 keeps one monolithic pmean."""
     step = _lm_explicit_dp_step_fn(model, tx, aux_weight, data_axis,
                                    mesh.shape[data_axis], grad_bucket_mb,
-                                   loss_chunk)
+                                   loss_chunk, health)
     return _wrap_explicit_step(step, mesh, data_axis, donate)
 
 
@@ -349,7 +355,8 @@ def make_lm_tp_ring_train_step(model, tx, mesh: Mesh,
                                model_axis: str = MODEL_AXIS,
                                aux_weight: float = 0.01,
                                donate: bool = True,
-                               loss_chunk: int = 0) -> Callable:
+                               loss_chunk: int = 0,
+                               health: str = "record") -> Callable:
     """dp x TP step over the ring collective matmul (tp_impl='ring'):
     shard_map over (data, model), batch sharded on 'data', the model's
     ppermute rings running over 'model'. ``model`` must be built with
@@ -358,7 +365,7 @@ def make_lm_tp_ring_train_step(model, tx, mesh: Mesh,
     granularity than GSPMD's global per-row amax), so quant parity is
     loss-level, not bitwise."""
     step = _lm_tp_ring_step_fn(model, tx, aux_weight, data_axis, model_axis,
-                               mesh.shape[model_axis], loss_chunk)
+                               mesh.shape[model_axis], loss_chunk, health)
     return _wrap_explicit_step(step, mesh, data_axis, donate)
 
 
@@ -427,7 +434,8 @@ def make_lm_indexed_multi_train_step(model, tx, mesh: Mesh,
                                      data_axis: str = DATA_AXIS,
                                      aux_weight: float = 0.01,
                                      donate: bool = True,
-                                     loss_chunk: int = 0) -> Callable:
+                                     loss_chunk: int = 0,
+                                     health: str = "record") -> Callable:
     """K optimizer steps per dispatch from an HBM-RESIDENT token corpus.
 
     signature: (state, rows_all (N, L+1) i32 REPLICATED, idx (K, B) i32
@@ -443,7 +451,7 @@ def make_lm_indexed_multi_train_step(model, tx, mesh: Mesh,
     """
     repl = NamedSharding(mesh, P())
     idx_sh = NamedSharding(mesh, P(None, data_axis))
-    one_step = _lm_step_fn(model, tx, aux_weight, loss_chunk)
+    one_step = _lm_step_fn(model, tx, aux_weight, loss_chunk, health)
 
     def multi(state: TrainState, rows_all, idx, rng):
         def body(st, idx_b):
@@ -518,7 +526,8 @@ def make_lm_sp_eval_step(model_ctor: Callable, mesh: Mesh,
 
 
 def _lm_sp_step_fn(model, tx, aux_weight: float, data_axis: str,
-                   seq_axis: str, loss_chunk: int = 0) -> Callable:
+                   seq_axis: str, loss_chunk: int = 0,
+                   health: str = "record") -> Callable:
     """THE per-device sp train step shared by the single-batch and
     indexed-window wrappers (the sp twin of _lm_step_fn): runs INSIDE
     shard_map on a (data, seq) mesh with (B/data, L/seq) token shards.
@@ -558,7 +567,7 @@ def _lm_sp_step_fn(model, tx, aux_weight: float, data_axis: str,
             lambda g: jax.lax.pmean(jax.lax.pmean(g, seq_axis), data_axis), grads)
         metrics = jax.tree.map(
             lambda m: jax.lax.psum(jax.lax.psum(m, seq_axis), data_axis), metrics)
-        return _apply_update(tx, state, grads, stats, metrics)
+        return _apply_update(tx, state, grads, stats, metrics, health)
 
     return step
 
@@ -579,7 +588,8 @@ def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,
                           seq_axis: str = SEQ_AXIS,
                           aux_weight: float = 0.01,
                           donate: bool = True,
-                          loss_chunk: int = 0) -> Callable:
+                          loss_chunk: int = 0,
+                          health: str = "record") -> Callable:
     """shard_map step: batch on 'data', sequence on 'seq', ring attention.
 
     ``model_ctor(attn_fn)`` builds the model with the given attention fn so
@@ -590,7 +600,7 @@ def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,
 
     model = model_ctor(attn_fn=ring_attention_fn(seq_axis))
     per_device = _lm_sp_step_fn(model, tx, aux_weight, data_axis, seq_axis,
-                                loss_chunk)
+                                loss_chunk, health)
 
     sharded = shard_map(
         per_device, mesh=mesh,
@@ -605,7 +615,8 @@ def make_lm_sp_indexed_multi_train_step(model_ctor: Callable, tx, mesh: Mesh,
                                         seq_axis: str = SEQ_AXIS,
                                         aux_weight: float = 0.01,
                                         donate: bool = True,
-                                        loss_chunk: int = 0) -> Callable:
+                                        loss_chunk: int = 0,
+                                        health: str = "record") -> Callable:
     """K sp optimizer steps per dispatch from HBM-resident rows (VERDICT r3
     #3 — the long-context mode was locked out of dispatch amortization,
     paying a host round-trip plus full token upload per step on exactly the
@@ -625,7 +636,7 @@ def make_lm_sp_indexed_multi_train_step(model_ctor: Callable, tx, mesh: Mesh,
     model = model_ctor(attn_fn=ring_attention_fn(seq_axis))
     n_seq = mesh.shape[seq_axis]
     one_step = _lm_sp_step_fn(model, tx, aux_weight, data_axis, seq_axis,
-                              loss_chunk)
+                              loss_chunk, health)
 
     def per_device(state: TrainState, rows_all, idx, rng):
         shard_len = (rows_all.shape[1] - 1) // n_seq

@@ -93,7 +93,32 @@ def _loss_and_metrics(model, transform, params, batch_stats, images_u8, labels,
     return prec.scale_loss(mean_loss, loss_scale), (new_stats, metrics)
 
 
-def _apply_update(tx, state: TrainState, grads, new_stats, metrics):
+def _apply_update(tx, state: TrainState, grads, new_stats, metrics,
+                  health: str = "record", probe_sync=None):
+    """Optimizer update + the fused numerical-health probes (obs.health).
+
+    Every engine flavor — jit, shard_map, windowed, bucketed, ring, sp, pp
+    — funnels its post-sync gradients through here, so the probes
+    (grad_norm / nonfinite_count / update_norm) join EVERY step's metric
+    sums and ride the existing drain-boundary fetch: zero new host syncs.
+    ``health='skip'`` additionally gates the whole step on the probes: a
+    non-finite gradient or update keeps params, optimizer state AND batch
+    stats bit-identical while the step counter still advances — the data
+    stream and the per-step RNG fold (both keyed on ``state.step``) move
+    on, so N hosts stay in lockstep (the gate reads post-sync grads and is
+    identical everywhere). ``health`` is trace-time static.
+
+    ``probe_sync`` covers the one caller whose grads are NOT fully synced
+    here: pipeline parallelism keeps block grads stage-local, so the pp
+    step builders pass a stage-psum that makes the probe scalars (and any
+    skip decision) identical on every device. The psum'd values are
+    INDICATORS, not exact global quantities: the stage-replicated
+    embed/head grads contribute once per stage, so a non-finite leaf
+    there counts n_stages times and the summed per-stage norms upper-
+    bound the true global norm — the >0 / finiteness gates are unaffected.
+    """
+    from tpu_dist.obs.health import probe_update_metrics, probes_ok
+
     grads, new_scale, finite = prec.unscale_and_update(grads, state.loss_scale)
     if hasattr(tx, "apply"):  # FusedSGD protocol: fused params+momentum update
         new_params, new_opt = tx.apply(state.params, grads, state.opt_state,
@@ -107,12 +132,32 @@ def _apply_update(tx, state: TrainState, grads, new_stats, metrics):
             lambda n, o: jnp.where(finite, n, o), new_params, state.params)
         new_opt = jax.tree.map(
             lambda n, o: jnp.where(finite, n, o), new_opt, state.opt_state)
+    probes = probe_update_metrics(grads, state.params, new_params)
+    if state.loss_scale is not None:
+        # a dynamic-loss-scale overflow is ROUTINE (apex semantics: the
+        # finite gate above already reverted the update and halved the
+        # scale) — report the probes as clean zeros for that step so the
+        # sentry never counts a healthy fp16 run as a health trip
+        probes = jax.tree.map(
+            lambda v: jnp.where(finite, v, jnp.zeros_like(v)), probes)
+    if probe_sync is not None:
+        probes = probe_sync(probes)
+    if health == "skip":
+        ok = probes_ok(probes)
+        new_params = jax.tree.map(
+            lambda n, o: jnp.where(ok, n, o), new_params, state.params)
+        new_opt = jax.tree.map(
+            lambda n, o: jnp.where(ok, n, o), new_opt, state.opt_state)
+        # a NaN forward poisons BN running stats too — skip means skip
+        new_stats = jax.tree.map(
+            lambda n, o: jnp.where(ok, n, o), new_stats, state.batch_stats)
+    metrics = {**metrics, **probes}
     return TrainState(step=state.step + 1, params=new_params,
                       batch_stats=new_stats, opt_state=new_opt,
                       loss_scale=new_scale), metrics
 
 
-def _train_step_fn(model, tx, transform) -> Callable:
+def _train_step_fn(model, tx, transform, health: str = "record") -> Callable:
     """The pure (unjitted) train step shared by all wrappers."""
 
     def step(state: TrainState, images_u8, labels, rng):
@@ -125,17 +170,18 @@ def _train_step_fn(model, tx, transform) -> Callable:
         (_, (new_stats, metrics)), grads = grad_fn(state.params)
         # grads of replicated params w.r.t. a sharded-batch mean ARE the
         # cross-replica mean — XLA emits the all-reduce (DDP equivalence).
-        return _apply_update(tx, state, grads, new_stats, metrics)
+        return _apply_update(tx, state, grads, new_stats, metrics, health)
 
     return step
 
 
 def make_train_step(model, tx, transform, mesh: Mesh,
-                    data_axis: str = DATA_AXIS, donate: bool = True) -> Callable:
+                    data_axis: str = DATA_AXIS, donate: bool = True,
+                    health: str = "record") -> Callable:
     """Compiler-partitioned step: jit over mesh, batch sharded, params replicated."""
     repl = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(data_axis))
-    return jax.jit(_train_step_fn(model, tx, transform),
+    return jax.jit(_train_step_fn(model, tx, transform, health),
                    in_shardings=(None, batch_sh, batch_sh, repl),
                    out_shardings=(None, repl),
                    donate_argnums=(0,) if donate else ())
@@ -143,7 +189,8 @@ def make_train_step(model, tx, transform, mesh: Mesh,
 
 def make_multi_train_step(model, tx, transform, mesh: Mesh,
                           data_axis: str = DATA_AXIS,
-                          donate: bool = True) -> Callable:
+                          donate: bool = True,
+                          health: str = "record") -> Callable:
     """K optimizer steps in ONE dispatch: lax.scan over stacked batches.
 
     signature: (state, images_u8 (K,B,...), labels (K,B), rng) -> (state,
@@ -154,7 +201,7 @@ def make_multi_train_step(model, tx, transform, mesh: Mesh,
     """
     repl = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(None, data_axis))
-    step = _train_step_fn(model, tx, transform)
+    step = _train_step_fn(model, tx, transform, health)
 
     def multi(state: TrainState, images_u8, labels, rng):
         def body(st, batch):
@@ -189,7 +236,8 @@ def pack_images_for_device(images_u8):
 
 def make_indexed_multi_train_step(model, tx, transform, mesh: Mesh,
                                   image_shape, data_axis: str = DATA_AXIS,
-                                  donate: bool = True) -> Callable:
+                                  donate: bool = True,
+                                  health: str = "record") -> Callable:
     """K steps per dispatch reading a DEVICE-RESIDENT dataset by index.
 
     signature: (state, images_all REPLICATED (packed via
@@ -209,7 +257,7 @@ def make_indexed_multi_train_step(model, tx, transform, mesh: Mesh,
     h, w, c = image_shape
     repl = NamedSharding(mesh, P())
     idx_sh = NamedSharding(mesh, P(None, data_axis))
-    step = _train_step_fn(model, tx, transform)
+    step = _train_step_fn(model, tx, transform, health)
 
     def multi(state: TrainState, images_all, labels_all, idx, rng):
         def body(st, idx_b):
@@ -286,7 +334,8 @@ def make_eval_step(model, transform, mesh: Mesh,
 
 def make_grad_accum_train_step(model, tx, transform, mesh: Mesh,
                                data_axis: str = DATA_AXIS,
-                               donate: bool = True) -> Callable:
+                               donate: bool = True,
+                               health: str = "record") -> Callable:
     """ONE optimizer step from K microbatches (gradient accumulation).
 
     signature: (state, images_u8 (K,B,...), labels (K,B), rng) -> (state,
@@ -323,7 +372,7 @@ def make_grad_accum_train_step(model, tx, transform, mesh: Mesh,
             micro, (zeros, state.batch_stats, jnp.int32(0)),
             (images_u8, labels))
         metrics = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
-        return _apply_update(tx, state, grads, new_stats, metrics)
+        return _apply_update(tx, state, grads, new_stats, metrics, health)
 
     return jax.jit(step,
                    in_shardings=(None, batch_sh, batch_sh, repl),
@@ -338,7 +387,8 @@ def make_shard_map_train_step(model, tx, transform, mesh: Mesh,
                               adasum: bool = False,
                               donate: bool = True,
                               grad_bucket_mb: float = 0.0,
-                              model_axis: Optional[str] = None) -> Callable:
+                              model_axis: Optional[str] = None,
+                              health: str = "record") -> Callable:
     """Explicit-collective step (horovod-equivalent, reference variant 5).
 
     Per-device program via shard_map; gradient averaging is an explicit psum
@@ -403,7 +453,7 @@ def make_shard_map_train_step(model, tx, transform, mesh: Mesh,
         # per-replica BN stats -> pmean (≈ horovod local BN + periodic sync)
         new_stats = jax.tree.map(lambda s: jax.lax.pmean(s, data_axis), new_stats)
         metrics = jax.tree.map(lambda m: jax.lax.psum(m, data_axis), metrics)
-        return _apply_update(tx, state, grads, new_stats, metrics)
+        return _apply_update(tx, state, grads, new_stats, metrics, health)
 
     sharded = shard_map(
         per_device, mesh=mesh,

@@ -24,22 +24,32 @@ readers can tell the two apart.
 
 from __future__ import annotations
 
+import atexit
 import dataclasses
 import os
+import signal
+import sys
+import threading
 import time
+import traceback
 from typing import Optional
 
+from tpu_dist.obs.health import HealthError, HealthSentry, validate_health
 from tpu_dist.obs.ledger import (EVENT_SCHEMA, EpochCsvSink, Ledger,
                                  ProgressSink, per_process_path, phase_totals,
                                  read_ledger)
+from tpu_dist.obs.metrics import (MetricsRegistry, metrics_ledger_sink,
+                                  serve_metrics)
 from tpu_dist.obs.skew import SkewMonitor
 from tpu_dist.obs.trace import StepTracer, profile_session, step_annotation
 from tpu_dist.obs.watchdog import Watchdog
 
-__all__ = ["EVENT_SCHEMA", "EpochCsvSink", "Ledger", "ProgressSink",
+__all__ = ["EVENT_SCHEMA", "EpochCsvSink", "HealthError", "HealthSentry",
+           "Ledger", "MetricsRegistry", "ProgressSink",
            "RunObs", "SkewMonitor", "StepTracer", "Watchdog",
-           "per_process_path", "phase_totals", "profile_session",
-           "read_ledger", "step_annotation"]
+           "metrics_ledger_sink", "per_process_path", "phase_totals",
+           "profile_session", "read_ledger", "serve_metrics",
+           "step_annotation"]
 
 
 def effective_peak_tflops() -> tuple:
@@ -87,18 +97,40 @@ class RunObs:
         wd_factor = getattr(cfg, "watchdog_factor", 0.0) or 0.0
         self.watchdog = (Watchdog(wd_factor, ledger=self.ledger)
                          if wd_factor > 0 else None)
+        # numerical-health sentry (obs.health): consumes the fused step
+        # probes + loss at each drain; skip/halt policy from the config
+        self.health = HealthSentry(
+            policy=validate_health(getattr(cfg, "health", "record")),
+            spike_z=getattr(cfg, "health_spike_z", 8.0) or 0.0,
+            ledger=self.ledger)
+        # live metrics export (obs.metrics): the registry is fed by a
+        # ledger sink — everything emitted (steps, stalls, skew, health,
+        # hbm, decode) reaches the scrape through the one event stream
+        self.metrics = MetricsRegistry()
+        self.ledger.add_sink(metrics_ledger_sink(self.metrics))
+        self.metrics_server = None
+        metrics_port = getattr(cfg, "metrics_port", 0) or 0
+        if metrics_port > 0:
+            # .pN story for ports: process i serves metrics_port + i
+            self.metrics_server = serve_metrics(self.metrics,
+                                                metrics_port + pidx)
         self.peak_tflops, self.peak_is_nominal = effective_peak_tflops()
         self._mesh_info = (
             {name: int(size) for name, size in mesh.shape.items()}
             if mesh is not None else None)
         self._t0 = time.time()
         self.steps = 0
+        self._ended = False
+        self._crash_tb: Optional[str] = None
+        self._prev_excepthook = None
+        self._prev_sigterm = None
 
     # -- lifecycle ------------------------------------------------------
     def run_start(self) -> None:
         import jax
 
         self._t0 = time.time()
+        self._ended = False
         self.ledger.emit(
             "run_start", kind=self.kind,
             config=dataclasses.asdict(self.cfg)
@@ -109,13 +141,106 @@ class RunObs:
             device_count=jax.device_count(),
             peak_tflops=self.peak_tflops,
             peak_is_nominal=self.peak_is_nominal)
+        self._arm_crash_guard()
 
-    def run_end(self, **extra) -> None:
+    def run_end(self, status: Optional[str] = None, **extra) -> None:
+        """Final rollup + shutdown. Idempotent (the crash guard's atexit
+        hook and a loop's ``finally`` may both call it). ``status`` is
+        derived from the active exception when not given — the loops call
+        this from a ``finally``, where ``sys.exc_info()`` still sees the
+        in-flight crash — so an unhandled exception stamps
+        ``status="crashed"`` plus a truncated traceback without any
+        call-site ceremony. The ledger file is line-buffered, so every
+        prior event is already on disk even if this emit never runs."""
+        if self._ended:
+            return
+        self._ended = True
+        self._disarm_crash_guard()
         if self.watchdog is not None:
             self.watchdog.stop()
+        if status is None:
+            exc = sys.exc_info()[1]
+            if exc is None and self._crash_tb is not None:
+                status = "crashed"
+                extra.setdefault("error", self._crash_tb)
+            elif isinstance(exc, KeyboardInterrupt):
+                status = "interrupted"
+            elif exc is not None:
+                status = "crashed"
+                extra.setdefault("error", "".join(
+                    traceback.format_exception(type(exc), exc,
+                                               exc.__traceback__))[-2000:])
+            else:
+                status = "ok"
+        # the registry's final values survive in the flight record after
+        # the scrape endpoint is gone
+        self.ledger.emit("metrics_snapshot", metrics=self.metrics.snapshot())
         self.ledger.emit("run_end", steps=self.steps,
-                         seconds=round(time.time() - self._t0, 3), **extra)
+                         seconds=round(time.time() - self._t0, 3),
+                         status=status, health_trips=self.health.trips,
+                         **extra)
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
         self.ledger.close()
+
+    # -- crash-safe shutdown -------------------------------------------
+    # An unhandled exception reaches run_end via the loops' finally (and
+    # sys.exc_info stamps it); the guard covers the paths finally cannot:
+    # SIGTERM (the scheduler's preemption signal — default handling kills
+    # the process with no cleanup) and interpreter exit without run_end
+    # (a caller that never wrapped the loop). Armed at run_start, disarmed
+    # at run_end; emit is microseconds on a line-buffered file.
+    def _arm_crash_guard(self) -> None:
+        atexit.register(self._atexit_end)
+        self._prev_excepthook = sys.excepthook
+        sys.excepthook = self._excepthook
+        try:
+            if threading.current_thread() is threading.main_thread():
+                self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                                   self._on_sigterm)
+        except (ValueError, OSError):  # non-main thread / exotic platform
+            self._prev_sigterm = None
+
+    def _disarm_crash_guard(self) -> None:
+        try:
+            atexit.unregister(self._atexit_end)
+        except Exception:
+            pass
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except (ValueError, OSError):
+                pass
+            self._prev_sigterm = None
+
+    def _excepthook(self, exc_type, exc, tb) -> None:
+        # record the traceback for the atexit emit, then defer to the
+        # previous hook (never swallow the crash report)
+        self._crash_tb = "".join(
+            traceback.format_exception(exc_type, exc, tb))[-2000:]
+        prev = self._prev_excepthook or sys.__excepthook__
+        prev(exc_type, exc, tb)
+
+    def _atexit_end(self) -> None:
+        if not self._ended:
+            self.run_end(status="crashed" if self._crash_tb else "ok",
+                         **({"error": self._crash_tb}
+                            if self._crash_tb else {}))
+
+    def _on_sigterm(self, signum, frame) -> None:
+        # capture BEFORE run_end: disarming inside it nulls _prev_sigterm,
+        # and a previously-installed handler (a preemption checkpoint
+        # hook, say) must still be chained
+        prev = self._prev_sigterm
+        self.run_end(status="crashed", error="SIGTERM")
+        if callable(prev):
+            prev(signum, frame)
+        else:
+            raise SystemExit(143)
 
     # -- per-step -------------------------------------------------------
     def step(self, step: int, loss: Optional[float], n_items: float,
@@ -155,7 +280,8 @@ class RunObs:
             comm_s=round(comm_s, 6) if comm_s is not None else None,
             mfu=float(f"{mfu:.4g}") if mfu is not None else None,
             tflops=float(f"{tflops:.4g}") if tflops is not None else None,
-            steps_in_dispatch=steps_in_dispatch, warm=warm, **extra)
+            steps_in_dispatch=steps_in_dispatch, warm=warm,
+            items=n_items, **extra)
         self.steps += steps_in_dispatch
         if self.skew is not None:
             self.skew.record(step, wall_s, data_s,

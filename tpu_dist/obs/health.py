@@ -1,0 +1,187 @@
+"""Numerical-health sentry: NaN/Inf grads and loss spikes, caught in-band.
+
+A fleet-scale run dies two ways the PR-2 watchdog cannot see: *numerically*
+(one bad batch or an LR spike pushes grads to Inf, the optimizer writes NaN
+into the params, and every step after that silently trains garbage) and
+*statistically* (loss explodes without ever going non-finite). MegaScale-
+style production stacks treat both as first-class signals. Two halves here:
+
+* **Device-side probes** (:func:`probe_update_metrics`) — global grad-norm,
+  non-finite-leaf count, and update-norm computed INSIDE the jitted train
+  step (``engine.steps._apply_update``, which every engine flavor funnels
+  through: jit / shard_map / windowed / bucketed / ring / sp / pp). The
+  probes are a few tree-reductions fused into the existing program and ride
+  the metrics dict the loops already fetch at drain boundaries — **zero new
+  host syncs**. With ``health='skip'`` the step also gates itself: a
+  non-finite gradient (or update) keeps params/opt-state/batch-stats
+  bit-identical while the step counter still advances, so the data stream
+  and the per-step RNG fold stay in multi-host lockstep (every process
+  computes the same post-sync gradients, so every process skips together).
+
+* **Host-side sentry** (:class:`HealthSentry`) — consumes the fetched
+  probes plus the already-fetched loss at each drain: a non-finite trip
+  emits a ``health`` ledger event (and raises :class:`HealthError` under
+  ``halt``); a trailing EMA/z-score detector flags loss SPIKES that never
+  go non-finite (the silent divergence case). Pure stdlib — the sentry
+  runs on numbers the loop already holds.
+
+Policy (``health`` knob in TrainConfig/LMConfig): ``record`` (probes +
+events only — the default), ``skip`` (zero the update, keep going),
+``halt`` (raise out of the loop; the crash-safe ledger shutdown then stamps
+``run_end`` with ``status='crashed'``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+HEALTH_POLICIES = ("record", "skip", "halt")
+
+PROBE_KEYS = ("grad_norm", "nonfinite_count", "update_norm")
+
+
+def validate_health(policy: str) -> str:
+    if policy not in HEALTH_POLICIES:
+        raise ValueError(f"unknown health policy {policy!r} "
+                         f"({'|'.join(HEALTH_POLICIES)})")
+    return policy
+
+
+class HealthError(RuntimeError):
+    """Raised by the sentry under ``health='halt'`` when a trip fires."""
+
+
+# -- device side (called at trace time from the jitted steps) --------------
+
+def _float_leaves(tree):
+    import jax
+    import jax.numpy as jnp
+
+    return [l for l in jax.tree.leaves(tree)
+            if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)]
+
+
+def probe_update_metrics(grads, old_params, new_params) -> dict:
+    """The fused health probes, as f32 scalars that join the step's metric
+    sums: global grad L2 norm, count of grad leaves whose squared-sum is
+    non-finite (any Inf/NaN value — or a norm overflow, which the gate
+    must catch anyway), and the L2 norm of the proposed parameter update.
+    ONE reduction pass per tree: the per-leaf squared sums feed both the
+    norm and the non-finite count (a single NaN/Inf poisons its leaf's
+    sum), so the whole probe set costs one sum-of-squares sweep over
+    grads plus one over the update. Computed from the POST-SYNC gradients
+    (every caller reduces grads before ``_apply_update``), so the values
+    — and any skip decision derived from them — are identical on every
+    device and host. Scalars sum across K-step dispatch windows like
+    every other metric; the loops divide by ``steps_in_dispatch`` for the
+    per-step view."""
+    import jax.numpy as jnp
+
+    def sq_sums(leaves):
+        return [jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves]
+
+    g_sq = sq_sums(_float_leaves(grads))
+    u_sq = [jnp.sum(jnp.square(n.astype(jnp.float32)
+                               - o.astype(jnp.float32)))
+            for n, o in zip(_float_leaves(new_params),
+                            _float_leaves(old_params))]
+    zero = jnp.float32(0.0)
+    return {
+        "grad_norm": jnp.sqrt(sum(g_sq)) if g_sq else zero,
+        "nonfinite_count": (sum((~jnp.isfinite(s)).astype(jnp.float32)
+                                for s in g_sq) if g_sq else zero),
+        "update_norm": jnp.sqrt(sum(u_sq)) if u_sq else zero,
+    }
+
+
+def probes_ok(probes: dict):
+    """Device-side gate for ``health='skip'``: True iff no grad leaf is
+    non-finite AND both norms are finite (an overflow that squares to Inf
+    is caught by the norm even when no single leaf is Inf yet)."""
+    import jax.numpy as jnp
+
+    return ((probes["nonfinite_count"] == 0)
+            & jnp.isfinite(probes["grad_norm"])
+            & jnp.isfinite(probes["update_norm"]))
+
+
+# -- host side -------------------------------------------------------------
+
+class HealthSentry:
+    """Drain-boundary consumer of the fetched probes + loss.
+
+    ``observe()`` is called by both engines' ``_drain`` once per step
+    record (numbers already on host — no sync). Trips:
+
+    * ``nonfinite`` — the record's non-finite-leaf count is > 0, a probe
+      norm came back non-finite, or the loss itself is NaN/Inf. Under
+      ``skip`` the device already zeroed the update; the event records
+      that. Under ``halt`` the sentry raises :class:`HealthError`.
+    * ``loss_spike`` — the loss is finite but more than ``spike_z``
+      trailing standard deviations above the EMA mean (EMA over the last
+      ~``2/alpha`` records, armed after ``warmup`` observations so early
+      fast-dropping losses never false-fire). A spike cannot be un-applied,
+      so its action is ``record`` unless the policy is ``halt``.
+
+    Every trip emits a ``health`` ledger event (EVENT_SCHEMA), which the
+    metrics registry's ledger sink turns into the
+    ``tpu_dist_health_trips_total`` counter.
+    """
+
+    def __init__(self, policy: str = "record", spike_z: float = 8.0,
+                 ledger=None, alpha: float = 0.05, warmup: int = 20):
+        self.policy = validate_health(policy)
+        self.spike_z = float(spike_z)
+        self.ledger = ledger
+        self.alpha = alpha
+        self.warmup = warmup
+        self._mean: Optional[float] = None
+        self._var = 0.0
+        self._n = 0
+        self.trips = 0
+        self.trips_by_kind: dict = {}
+
+    def _trip(self, step, kind: str, action: str, value, loss, grad_norm):
+        self.trips += 1
+        self.trips_by_kind[kind] = self.trips_by_kind.get(kind, 0) + 1
+        if self.ledger is not None:
+            self.ledger.emit("health", step=step, kind=kind,
+                             policy=self.policy, action=action, value=value,
+                             loss=loss, grad_norm=grad_norm)
+        if action == "halt":
+            raise HealthError(
+                f"health=halt: {kind} at step {step} (value={value!r}, "
+                f"loss={loss!r}, grad_norm={grad_norm!r}) — see the "
+                "'health' ledger event")
+
+    def observe(self, step: int, loss, nonfinite=None, grad_norm=None,
+                update_norm=None, n_steps: int = 1) -> None:
+        """One step record's worth of health signals (window records pass
+        their per-step means and the summed non-finite count)."""
+        loss = None if loss is None else float(loss)
+        loss_bad = loss is not None and not math.isfinite(loss)
+        probe_bad = any(v is not None and not math.isfinite(float(v))
+                        for v in (grad_norm, update_norm))
+        if (nonfinite and float(nonfinite) > 0) or probe_bad or loss_bad:
+            self._trip(step, "nonfinite", self.policy,
+                       float(nonfinite or 0), loss, grad_norm)
+            return  # a non-finite loss must not poison the spike EMA
+        if loss is None:
+            return
+        if self._mean is not None and self._n >= self.warmup \
+                and self.spike_z > 0:
+            std = math.sqrt(max(self._var, 1e-24))
+            z = (loss - self._mean) / std
+            if z > self.spike_z:
+                self._trip(step, "loss_spike",
+                           "halt" if self.policy == "halt" else "record",
+                           round(z, 3), loss, grad_norm)
+                return  # do not absorb the spike into the baseline
+        if self._mean is None:
+            self._mean = loss
+        else:
+            d = loss - self._mean
+            self._mean += self.alpha * d
+            self._var = (1 - self.alpha) * (self._var + self.alpha * d * d)
+        self._n += 1

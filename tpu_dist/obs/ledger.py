@@ -67,7 +67,15 @@ EVENT_SCHEMA = {
     "hbm": ("bytes_in_use",),
     # one generate() call (engine.generate with a ledger passed in)
     "decode": ("tokens", "seconds", "throughput"),
-    # run rollup: total steps, wall seconds, best metric in extras
+    # numerical-health trip (obs.health sentry: non-finite grads/loss or a
+    # loss spike); action records what the policy did (record|skip|halt)
+    "health": ("step", "kind", "policy", "action", "value"),
+    # final registry dump (obs.metrics) so counter values survive in the
+    # flight record after the scrape endpoint is gone
+    "metrics_snapshot": ("metrics",),
+    # run rollup: total steps, wall seconds, best metric in extras;
+    # status ("ok"|"crashed"|"interrupted") rides as an extra stamped by
+    # RunObs.run_end — the crash-safe shutdown path sets "crashed"
     "run_end": ("steps", "seconds"),
 }
 
@@ -113,7 +121,13 @@ class Ledger:
         self.path = path or None
         self.process_index = process_index
         self._f = open(path, "a", buffering=1) if path else None
-        self._lock = threading.Lock()
+        # RLock, not Lock: the crash guard's SIGTERM handler runs ON the
+        # main thread and emits run_end — if the signal lands while that
+        # same thread is inside emit(), a plain Lock would self-deadlock
+        # on exactly the preemption path the guard exists for. Re-entry
+        # writes the inner record as its own complete line (signals fire
+        # between bytecodes, never mid-write), so lines stay intact.
+        self._lock = threading.RLock()
         self._sinks: List[Callable[[dict], None]] = list(sinks)
         self.last: Optional[dict] = None  # most recent record (watchdog dump)
 
@@ -159,27 +173,45 @@ class Ledger:
                         pass
 
 
-def read_ledger(path: str, validate: bool = True) -> List[dict]:
+def read_ledger(path: str, validate: bool = True,
+                strict: bool = True) -> List[dict]:
     """Parse a ledger file back into typed records (the round-trip half of
     the schema contract: every line is a declared event carrying its
-    required fields)."""
+    required fields).
+
+    ``strict=False`` skips corrupt or truncated lines with a stderr
+    warning instead of raising — a process killed mid-``write`` leaves a
+    torn trailing line, and crashed runs are exactly the ones operators
+    inspect (tools/ledger_report and tools/trace_merge read this way)."""
+    import sys
+
     out = []
     with open(path) as f:
         for line_no, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
-            rec = json.loads(line)
-            if validate:
-                ev = rec.get("event")
-                required = EVENT_SCHEMA.get(ev)
-                if required is None:
-                    raise ValueError(
-                        f"{path}:{line_no}: undeclared event {ev!r}")
-                missing = [k for k in required if k not in rec]
-                if missing:
-                    raise ValueError(f"{path}:{line_no}: event {ev!r} "
-                                     f"missing {missing}")
+            try:
+                rec = json.loads(line)
+                if not isinstance(rec, dict):
+                    raise ValueError("not a JSON object")
+                if validate:
+                    ev = rec.get("event")
+                    required = EVENT_SCHEMA.get(ev)
+                    if required is None:
+                        raise ValueError(
+                            f"{path}:{line_no}: undeclared event {ev!r}")
+                    missing = [k for k in required if k not in rec]
+                    if missing:
+                        raise ValueError(f"{path}:{line_no}: event {ev!r} "
+                                         f"missing {missing}")
+            except (json.JSONDecodeError, ValueError):
+                if strict:
+                    raise
+                print(f"warning: {path}:{line_no}: skipping corrupt/"
+                      f"truncated ledger line ({line[:60]!r}...)",
+                      file=sys.stderr)
+                continue
             out.append(rec)
     return out
 

@@ -1,0 +1,388 @@
+"""Process-local metrics registry + Prometheus scrape endpoint (stdlib only).
+
+The ledger is a flight recorder — perfect for post-mortems, useless for a
+live dashboard: an operator watching a 3-day run wants throughput, MFU,
+stall and health-trip counters NOW, from a scraper, without tailing JSONL
+over ssh. This module is the export half of the obs subsystem:
+
+* :class:`MetricsRegistry` — counters / gauges / histograms with optional
+  labels, rendered in the Prometheus text exposition format
+  (``render()``). Thread-safe (the watchdog and HBM sampler feed it from
+  daemon threads). No jax, no deps — importable on a login host.
+* :func:`metrics_ledger_sink` — a ledger sink that maps the typed event
+  stream onto the registry, so EVERYTHING that reaches the ledger (step
+  records, watchdog stalls, skew samples, health trips, HBM samples,
+  decode calls) feeds the scrape for free, from one mechanism. The
+  standard series are pre-registered so a scrape always carries the
+  stall/health counters even at zero.
+* :class:`MetricsServer` / :func:`serve_metrics` — a daemon-thread HTTP
+  endpoint serving ``render()`` on every GET (``/metrics`` by
+  convention). ``RunObs`` starts one per process when ``metrics_port`` is
+  set, at ``metrics_port + process_index`` — the ``.pN`` story, applied
+  to ports. A bind failure warns and disables; an exporter must never
+  take the run down.
+
+``RunObs.run_end`` snapshots the registry into a ``metrics_snapshot``
+ledger event, so the final counter values survive in the flight record
+after the endpoint is gone.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                   5.0, 10.0, 30.0)
+
+
+def _fmt(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _label_str(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    body = ",".join(f'{k}="{str(v)}"' for k, v in labels)
+    return "{" + body + "}"
+
+
+class _Metric:
+    """One named family; per-label-set children live in ``_series``."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._series: Dict[Tuple[Tuple[str, str], ...], object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            child = self._series.get(key)
+            if child is None:
+                child = self._new_child()
+                self._series[key] = child
+        return child
+
+    def _default(self):
+        return self.labels()
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def _render_series(self, out, key, child):
+        raise NotImplementedError
+
+    def render(self, out: list) -> None:
+        out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        with self._lock:
+            items = list(self._series.items())
+        for key, child in sorted(items):
+            self._render_series(out, key, child)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {_label_str(k) or "": child.value_view()
+                    for k, child in self._series.items()}
+
+
+class _CounterChild:
+    def __init__(self):
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v += amount
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def value_view(self):
+        return self._v
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    def _render_series(self, out, key, child):
+        out.append(f"{self.name}{_label_str(key)} {_fmt(child.value)}")
+
+
+class _GaugeChild(_CounterChild):
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = float(v)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, v: float) -> None:
+        self._default().set(v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    def _render_series(self, out, key, child):
+        out.append(f"{self.name}{_label_str(key)} {_fmt(child.value)}")
+
+
+class _HistogramChild:
+    def __init__(self, buckets):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +1 = +Inf
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.sum += v
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+            self.counts[-1] += 1
+
+    def value_view(self):
+        return {"sum": self.sum, "count": self.count}
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_text="", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_text)
+        self.buckets = tuple(sorted(buckets))
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v: float) -> None:
+        self._default().observe(v)
+
+    def _render_series(self, out, key, child):
+        for b, c in zip(child.buckets, child.counts):
+            ls = _label_str(key + (("le", _fmt(b)),))
+            out.append(f"{self.name}_bucket{ls} {c}")
+        ls = _label_str(key + (("le", "+Inf"),))
+        out.append(f"{self.name}_bucket{ls} {child.counts[-1]}")
+        out.append(f"{self.name}_sum{_label_str(key)} {_fmt(child.sum)}")
+        out.append(f"{self.name}_count{_label_str(key)} {child.count}")
+
+
+class MetricsRegistry:
+    """Get-or-create registry of named metrics; ``render()`` is the scrape
+    payload, ``snapshot()`` the JSON-safe dump for ``metrics_snapshot``."""
+
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name, help_text, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_text, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(f"metric {name!r} already registered as "
+                                 f"{type(m).__name__}")
+        return m
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._get(Counter, name, help_text)
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._get(Gauge, name, help_text)
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help_text, buckets=buckets)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: list = []
+        for m in metrics:
+            m.render(out)
+        return "\n".join(out) + "\n"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            metrics = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in metrics}
+
+
+# -- the ledger -> registry bridge ----------------------------------------
+
+def metrics_ledger_sink(reg: MetricsRegistry):
+    """Build the sink that maps ledger events onto the registry. The
+    operator-facing series are pre-registered here so a scrape during a
+    healthy run still exposes the zero-valued stall/health counters
+    (absence and zero are different answers to "is it hung?")."""
+    steps = reg.counter("tpu_dist_steps_total",
+                        "optimizer steps recorded in the ledger")
+    items = reg.counter("tpu_dist_items_total",
+                        "items (images/tokens) trained, global batch")
+    throughput = reg.gauge("tpu_dist_step_throughput",
+                           "last step record's items/sec (unit label)")
+    mfu = reg.gauge("tpu_dist_mfu", "last step record's model FLOP/s "
+                    "utilization (0-1)")
+    loss = reg.gauge("tpu_dist_loss", "last recorded train loss")
+    phase = reg.counter("tpu_dist_phase_seconds_total",
+                        "host-measured step phase seconds by phase label")
+    step_hist = reg.histogram("tpu_dist_step_seconds",
+                              "per-optimizer-step wall seconds")
+    stalls = reg.counter("tpu_dist_stalls_total",
+                         "watchdog stall dumps fired")
+    stall_idle = reg.gauge("tpu_dist_stall_idle_seconds",
+                           "idle seconds at the last watchdog stall")
+    skew_spread = reg.gauge("tpu_dist_skew_spread_seconds",
+                            "last cross-host step-time spread (max-min)")
+    straggler = reg.gauge("tpu_dist_straggler_index",
+                          "process index of the last skew straggler")
+    health = reg.counter("tpu_dist_health_trips_total",
+                         "numerical-health trips by kind")
+    health.labels(kind="nonfinite")       # pre-register: scrape shows 0
+    health.labels(kind="loss_spike")
+    epoch_g = reg.gauge("tpu_dist_epoch", "last completed epoch")
+    eval_loss = reg.gauge("tpu_dist_eval_loss", "last held-out eval loss")
+    hbm = reg.gauge("tpu_dist_hbm_bytes_in_use", "last HBM sampler reading")
+    decode_toks = reg.counter("tpu_dist_decode_tokens_total",
+                              "tokens produced by generate() calls")
+    # materialize the unlabeled children too — a family with no child
+    # renders no sample line, and "0" vs "absent" are different answers
+    # to "is it hung?"
+    for m in (steps, items, mfu, loss, stalls, stall_idle, skew_spread,
+              straggler, epoch_g, eval_loss, hbm, decode_toks, step_hist):
+        m.labels()
+
+    def sink(rec: dict) -> None:
+        ev = rec.get("event")
+        if ev == "step":
+            n = rec.get("steps_in_dispatch") or 1
+            steps.inc(n)
+            if rec.get("items"):
+                items.inc(rec["items"])
+            if rec.get("throughput") is not None:
+                throughput.labels(unit=rec.get("unit") or "items/s").set(
+                    rec["throughput"])
+            if rec.get("mfu") is not None:
+                mfu.set(rec["mfu"])
+            if rec.get("loss") is not None:
+                loss.set(rec["loss"])
+            wall = 0.0
+            for key, lbl in (("data_s", "data"), ("dispatch_s", "dispatch"),
+                             ("device_s", "device"), ("comm_s", "comm")):
+                v = rec.get(key)
+                if v:
+                    phase.labels(phase=lbl).inc(v)
+                    if key != "comm_s":  # comm overlaps device_s
+                        wall += v
+            if wall:
+                step_hist.observe(wall / n)
+        elif ev == "stall":
+            stalls.inc()
+            if rec.get("idle_s") is not None:
+                stall_idle.set(rec["idle_s"])
+        elif ev == "skew":
+            if rec.get("spread_s") is not None:
+                skew_spread.set(rec["spread_s"])
+            if rec.get("straggler") is not None:
+                straggler.set(rec["straggler"])
+        elif ev == "health":
+            health.labels(kind=rec.get("kind") or "unknown").inc()
+        elif ev == "epoch":
+            if rec.get("epoch") is not None:
+                epoch_g.set(rec["epoch"])
+        elif ev == "eval":
+            if rec.get("loss") is not None:
+                eval_loss.set(rec["loss"])
+        elif ev == "hbm":
+            if rec.get("bytes_in_use") is not None:
+                hbm.set(rec["bytes_in_use"])
+        elif ev == "decode":
+            if rec.get("tokens"):
+                decode_toks.inc(rec["tokens"])
+
+    return sink
+
+
+# -- the scrape endpoint ---------------------------------------------------
+
+class MetricsServer:
+    """Daemon-thread HTTP server rendering the registry on every GET."""
+
+    def __init__(self, registry: MetricsRegistry, port: int,
+                 host: str = "0.0.0.0"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        reg = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                body = reg.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrapes must not spam stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            name="tpu-dist-metrics", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2.0)
+
+
+def serve_metrics(registry: MetricsRegistry, port: int,
+                  host: str = "0.0.0.0") -> Optional[MetricsServer]:
+    """Start the endpoint; on bind failure warn and return None — the
+    exporter is an accessory, never a reason to lose a run."""
+    try:
+        return MetricsServer(registry, port, host)
+    except OSError as e:
+        print(f"tpu_dist metrics endpoint disabled: cannot bind port "
+              f"{port} ({e})", file=sys.stderr)
+        return None
