@@ -243,8 +243,7 @@ def lm_bench():
     amortized over the multi-second window.
     """
     import jax
-    from tpu_dist.utils.mfu import (lm_flops_per_token, peak_tflops_for,
-                                    step_flops)
+    from tpu_dist.utils.mfu import lm_flops_per_token, peak_tflops_for
 
     if ARCH != "transformer_lm":
         raise SystemExit(
@@ -265,11 +264,6 @@ def lm_bench():
     # analytical model FLOPs (tpu_dist.utils.mfu.lm_flops_per_token; XLA's
     # cost model undercounts scan bodies and cannot cost Pallas kernels)
     flops_per_token = lm_flops_per_token(b["params"], layers, L, d_model)
-    xla_flops = step_flops(window, state, rows_dev, idx_dev, key)
-    if xla_flops:
-        print(f"xla cost model (diagnostic only): "
-              f"{xla_flops / (batch * L / n_chips) / 1e6:.2f} MFLOP/token vs "
-              f"analytical {flops_per_token / 1e6:.2f}", file=sys.stderr)
     ledger, ledger_path = bench_ledger("bench_lm", lm_geometry())
     t_warm = time.perf_counter()
     state, m = window(state, rows_dev, idx_dev, key)           # compile+warm
@@ -277,6 +271,25 @@ def lm_bench():
     if ledger:
         ledger.emit("compile", program="window_step",
                     seconds=round(time.perf_counter() - t_warm, 3))
+    # probe AFTER the warm dispatch (telemetry.program_stats contract —
+    # the AOT lower does not seed jit's dispatch cache, so probing first
+    # would compile the window twice); one lower yields the cost-model
+    # cross-check AND the HLO for cost attribution when a ledger rides
+    from tpu_dist.utils.telemetry import program_stats
+    st = program_stats(window, state, rows_dev, idx_dev, key,
+                       with_hlo=bool(ledger))
+    xla_flops = st["flops"]
+    if xla_flops:
+        print(f"xla cost model (diagnostic only): "
+              f"{xla_flops / (batch * L / n_chips) / 1e6:.2f} MFLOP/token vs "
+              f"analytical {flops_per_token / 1e6:.2f}", file=sys.stderr)
+    else:
+        print("xla cost model unavailable on this backend (cross-check "
+              "and ledger cost attribution skipped)", file=sys.stderr)
+    if ledger and st.get("hlo"):
+        from tpu_dist.obs.attr import emit_cost_model
+        emit_cost_model(ledger, "window_step", st["hlo"],
+                        xla_flops=xla_flops)
     peak = peak_tflops_for(jax.devices()[0])
     rates, phases = [], []
     for i in range(trials):
@@ -388,29 +401,34 @@ def build(model_kwargs, batch, k):
     return step, single, state, images, labels
 
 
-def flops_per_step(single, state, images, labels, key) -> float | None:
-    """One training step's FLOPs from XLA's cost model (the SINGLE-step
-    program — the scan flavor's cost analysis counts its body only once,
-    so it can't be trusted for per-step math); None if unavailable."""
-    try:
-        cost = single.lower(state, images[0], labels[0],
-                            key).compile().cost_analysis()
-        if isinstance(cost, list):  # older API: one dict per device program
-            cost = cost[0]
-        return float(cost["flops"])
-    except Exception as e:
-        print(f"cost_analysis unavailable: {e!r}", file=sys.stderr)
-        return None
+def flops_per_step(single, state, images, labels, key,
+                   with_hlo: bool = False) -> dict:
+    """One training step's {'flops', 'hlo'} from the SINGLE-step program
+    (the scan flavor's cost analysis counts its body only once, so it
+    can't be trusted for per-step math; `single` is never dispatched, so
+    its AOT compile is the only one it pays). ``with_hlo`` additionally
+    returns the optimized HLO for cost attribution (obs.attr)."""
+    from tpu_dist.utils.telemetry import program_stats
+
+    st = program_stats(single, state, images[0], labels[0], key,
+                       with_hlo=with_hlo)
+    if st["flops"] is None:
+        print("cost_analysis unavailable", file=sys.stderr)
+    return st
 
 
-def measure(model_kwargs, per_chip_batch, k, trials):
+def measure(model_kwargs, per_chip_batch, k, trials, with_hlo=False):
     import jax
 
     n_chips = jax.device_count()
     batch = per_chip_batch * n_chips
     step, single, state, images, labels = build(model_kwargs, batch, k)
     key = jax.random.PRNGKey(0)
-    step_flops = flops_per_step(single, state, images, labels, key)
+    # with_hlo only on the headline run: the sweep discards everything
+    # past the rate, and the optimized-HLO text can run to megabytes
+    st = flops_per_step(single, state, images, labels, key,
+                        with_hlo=with_hlo)
+    step_flops = st["flops"]
 
     # warmup: compile + one full window
     state, metrics = step(state, images, labels, key)
@@ -429,7 +447,7 @@ def measure(model_kwargs, per_chip_batch, k, trials):
     best_phases = phases[rates.index(max(rates))]
     return (max(rates), sorted(rates), step_flops, batch, best_phases,
             list(zip(rates, phases)),  # trials in timing order (ledger)
-            health_block(metrics, k))
+            health_block(metrics, k), st.get("hlo"))
 
 
 def main():
@@ -541,8 +559,9 @@ def main():
                 f"ResNet knobs; unset them with BENCH_ARCH={ARCH}")
         kwargs = {}
         default_model = True
-    best, rates, window_flops, batch, phases, trial_data, health = measure(
-        kwargs, per_chip_batch, k, trials)
+    (best, rates, window_flops, batch, phases, trial_data, health,
+     step_hlo) = measure(kwargs, per_chip_batch, k, trials,
+                         with_hlo=bool(os.environ.get("BENCH_LEDGER")))
     ips_per_chip, tflops, mfu, fpi = report("headline", best, rates,
                                             window_flops, batch)
     ledger, ledger_path = bench_ledger(
@@ -557,6 +576,12 @@ def main():
         # non-null on CPU — run_start carries peak_is_nominal)
         from tpu_dist.obs import effective_peak_tflops
         eff_peak = effective_peak_tflops()[0]
+        if step_hlo:
+            # cost attribution of the single-step program (obs.attr) —
+            # the ledger_report roofline reads it back beside the trials
+            from tpu_dist.obs.attr import emit_cost_model
+            emit_cost_model(ledger, "train_step", step_hlo,
+                            xla_flops=window_flops)
         for i, (rate, ph) in enumerate(trial_data):
             r_chip = rate / n_chips
             tf = r_chip * fpi / 1e12 if fpi else None

@@ -13,3 +13,8 @@ cd "$(dirname "$0")/.."
 
 python -m tools.distlint tpu_dist tools bench.py "$@"
 python -m tools.distlint --select DL006 tests scripts
+
+# Bench-trajectory gate (tools/bench_track.py, stdlib-only): the newest
+# checked-in BENCH_r*.json must not have dropped >5% below the metric's
+# trailing best — the apex-data_prefetcher class of silent regression.
+python tools/bench_track.py --check

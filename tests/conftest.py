@@ -20,3 +20,72 @@ if os.environ.get("TPU_DIST_TEST_TPU") != "1":
 
     set_cpu_device_count(8)
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+
+# ---- tier-1 budget self-observability -----------------------------------
+# The suite runs 620-870s against an 870s timeout (±30% machine variance,
+# ROADMAP budget guardrail); budget creep was being rediscovered by
+# timeout instead of tracked. Every run writes its wall time and the
+# top-20 test durations to TPU_DIST_TIER1_DURATIONS (default
+# /tmp/tier1_durations.json) and prints one summary line, so a creeping
+# test is visible in the run that introduced it. Hooks are best-effort:
+# budget telemetry must never fail the suite.
+
+import time as _time
+
+_suite_t0 = _time.time()
+_durations = []  # (seconds, nodeid) across setup+call+teardown
+
+
+def pytest_runtest_logreport(report):
+    try:
+        if report.duration:
+            _durations.append((float(report.duration), report.nodeid))
+    except Exception:
+        pass
+
+
+def _is_full_suite(config) -> bool:
+    """Only the tier-1-shaped run may overwrite the budget artifact: a
+    `pytest tests/test_x.py -k one` or `-m slow` run would otherwise
+    clobber the full-suite record the hook exists to track. The tier-1
+    marker filter `-m 'not slow'` (and no filter at all) still counts."""
+    if getattr(config.option, "keyword", ""):
+        return False
+    if getattr(config.option, "markexpr", "") not in ("", "not slow"):
+        return False
+    for a in config.invocation_params.args:
+        a = str(a)
+        if a.endswith(".py") or "::" in a:
+            return False
+    return True
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    try:
+        import json
+
+        wall = _time.time() - _suite_t0
+        # sum setup/call/teardown phases per test, rank by total
+        per_test = {}
+        for secs, nodeid in _durations:
+            per_test[nodeid] = per_test.get(nodeid, 0.0) + secs
+        top = sorted(per_test.items(), key=lambda kv: -kv[1])[:20]
+        path = os.environ.get("TPU_DIST_TIER1_DURATIONS",
+                              "/tmp/tier1_durations.json")
+        wrote = ""
+        if _is_full_suite(config):
+            with open(path, "w") as f:
+                json.dump({"wall_s": round(wall, 1),
+                           "tests": len(per_test),
+                           "exitstatus": int(exitstatus),
+                           "top": [{"nodeid": n, "s": round(s, 2)}
+                                   for n, s in top]}, f, indent=1)
+            wrote = f"; top-20 -> {path}"
+        slowest = (f"; slowest {top[0][1]:.1f}s {top[0][0]}"
+                   if top else "")
+        terminalreporter.write_line(
+            f"tier1-budget: {wall:.1f}s wall, {len(per_test)} tests"
+            f"{slowest}{wrote}")
+    except Exception:
+        pass

@@ -156,3 +156,50 @@ def test_http_scrape_endpoint():
     with pytest.raises(Exception):
         urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/metrics",
                                timeout=0.5)
+
+
+def test_build_info_gauge_from_run_start():
+    """The run_start event materializes the build_info identity gauge:
+    value 1, labels carrying run id / config hash / jax version /
+    quant / tp_impl — the join key across runs (PR 6 satellite)."""
+    reg = MetricsRegistry()
+    sink = metrics_ledger_sink(reg)
+    # pre-registered family renders (HELP/TYPE) before any run_start
+    assert "tpu_dist_build_info" in reg.render()
+    sink({"event": "run_start", "ts": 1234.5, "pid": 0, "kind": "lm",
+          "config": {"quant": "int8", "tp_impl": "ring", "lr": 0.1},
+          "jax_version": "9.9.9"})
+    text = reg.render()
+    assert_prometheus_parseable(text)
+    (line,) = [ln for ln in text.splitlines()
+               if ln.startswith("tpu_dist_build_info{")]
+    assert line.endswith(" 1")
+    for frag in ('run_id="1234-p0"', 'kind="lm"', 'quant="int8"',
+                 'tp_impl="ring"', 'jax="9.9.9"'):
+        assert frag in line, (frag, line)
+    # config hash is stable across identical configs, distinct otherwise
+    import hashlib
+
+    chash = hashlib.sha1(json.dumps(
+        {"quant": "int8", "tp_impl": "ring", "lr": 0.1},
+        sort_keys=True, default=str).encode()).hexdigest()[:12]
+    assert f'config_hash="{chash}"' in line
+
+
+def test_healthz_liveness_path():
+    """/healthz and /livez answer 'ok' without rendering the registry;
+    every other path still serves the scrape payload."""
+    reg = MetricsRegistry()
+    reg.counter("t_up", "liveness").inc()
+    srv = serve_metrics(reg, port=0, host="127.0.0.1")
+    try:
+        for path in ("/healthz", "/livez"):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}", timeout=5) as r:
+                assert r.status == 200
+                assert r.read().decode() == "ok\n"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            assert "t_up 1" in r.read().decode()
+    finally:
+        srv.close()

@@ -299,6 +299,11 @@ def _assert_run_shape(recs):
     events = [r["event"] for r in recs]
     assert events[0] == "run_start" and events[-1] == "run_end"
     assert "compile" in events and "epoch" in events and "eval" in events
+    # cost attribution rides the compile probe (obs.attr): buckets with
+    # real flops, matmul (or attention) among them
+    (cm,) = [r for r in recs if r["event"] == "cost_model"]
+    assert cm["total_flops"] > 0 and cm["buckets"]
+    assert any(c in cm["buckets"] for c in ("matmul", "attention"))
     run = recs[0]
     assert run["config"] and run["devices"] and run["mesh"]
     # crash-safe shutdown: a clean run stamps status=ok, and the registry

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Bench regression tracker over the checked-in BENCH_r*.json history.
+
+    python tools/bench_track.py                    # trend table (repo root)
+    python tools/bench_track.py --check            # CI gate: nonzero on drop
+    python tools/bench_track.py --json             # machine-readable
+    python tools/bench_track.py --headline out.json  # + this run's headline
+
+Every round of this repo drops a ``BENCH_r<N>.json`` (the bench driver's
+wrapper: ``{"n", "cmd", "rc", "tail", "parsed": {metric, value, unit,
+mfu, ...}}``) — five rounds of history that, until now, nothing read. This
+tool turns them into a guarded trajectory: a per-metric trend table
+(value, Δ%, MFU per round) and a threshold check that FAILS when the
+newest point drops more than ``--threshold-pct`` below the trailing best
+of its metric — the reference cookbook's apex ``data_prefetcher`` bug
+(PAPER.md) was exactly a silent per-round regression this would have
+caught at review time.
+
+Accepted inputs per file (positional args override the default glob):
+the wrapper format above, or a raw headline JSON object (``{"metric",
+"value", ...}`` — what ``bench.py`` prints) via ``--headline`` for the
+run-under-test. Different metric names track independently (quant/tp_impl
+variants publish their own names by design — bench.py), so a variant run
+never gates the bf16 headline. Stdlib only: runs in CI, on a login host,
+anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_points(paths: List[str], out_err=None) -> List[dict]:
+    """[{metric, value, round, file, unit, mfu, vs_baseline}] from wrapper
+    and raw-headline files alike; files with no parseable metric (failed
+    rounds, MULTICHIP dryruns) are skipped with a note."""
+    out_err = out_err or (lambda s: print(s, file=sys.stderr))
+    points = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            out_err(f"bench_track: skipping {path}: {e}")
+            continue
+        if not isinstance(doc, dict):
+            out_err(f"bench_track: skipping {path}: not a JSON object")
+            continue
+        parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
+            else (doc if "metric" in doc else None)
+        if not parsed or "metric" not in parsed or "value" not in parsed:
+            out_err(f"bench_track: skipping {path}: no parsed metric "
+                    "(failed round or non-bench file)")
+            continue
+        try:
+            value = float(parsed["value"])
+        except (TypeError, ValueError):
+            # a crashed round can leave value: null — skip, don't die
+            out_err(f"bench_track: skipping {path}: non-numeric value "
+                    f"{parsed['value']!r}")
+            continue
+        rnd = doc.get("n")
+        if rnd is None:
+            m = re.search(r"_r0*(\d+)\.json$", os.path.basename(path))
+            rnd = int(m.group(1)) if m else None
+        points.append({
+            "metric": parsed["metric"],
+            "value": value,
+            "unit": parsed.get("unit"),
+            "mfu": parsed.get("mfu"),
+            "vs_baseline": parsed.get("vs_baseline"),
+            "round": rnd,
+            "file": os.path.basename(path),
+        })
+    # order by round where known (unknown rounds sort last, in arg order —
+    # the --headline run-under-test lands there as the newest point)
+    points.sort(key=lambda p: (p["round"] is None, p["round"] or 0))
+    return points
+
+
+def track(points: List[dict], threshold_pct: float) -> dict:
+    """Group points by metric and judge the newest against the trailing
+    best: {'metrics': {name: {...}}, 'ok': bool}."""
+    by_metric: dict = {}
+    for p in points:
+        by_metric.setdefault(p["metric"], []).append(p)
+    report = {"metrics": {}, "ok": True, "threshold_pct": threshold_pct}
+    for name, series in by_metric.items():
+        latest = series[-1]
+        prior = series[:-1]
+        best_prior = max((p["value"] for p in prior), default=None)
+        drop_pct = None
+        regressed = False
+        if best_prior:
+            drop_pct = (best_prior - latest["value"]) / best_prior * 100.0
+            regressed = drop_pct > threshold_pct
+        rounds = [{"round": p["round"], "value": p["value"],
+                   "mfu": p["mfu"], "file": p["file"],
+                   "delta_pct": (None if i == 0 or not series[i - 1]["value"]
+                                 else (p["value"] / series[i - 1]["value"]
+                                       - 1.0) * 100.0)}
+                  for i, p in enumerate(series)]
+        report["metrics"][name] = {
+            "unit": latest["unit"], "rounds": rounds,
+            "latest": latest["value"], "best_prior": best_prior,
+            "drop_pct": drop_pct, "regressed": regressed,
+        }
+        if regressed:
+            report["ok"] = False
+    return report
+
+
+def render(report: dict, out=print) -> None:
+    for name, m in sorted(report["metrics"].items()):
+        out(f"{name} ({m['unit'] or '?'}):")
+        for r in m["rounds"]:
+            rnd = f"r{r['round']:02d}" if r["round"] is not None else "head"
+            out(f"  {rnd}  {r['value']:>12,.1f}"
+                + (f"  {r['delta_pct']:+6.1f}%" if r["delta_pct"] is not None
+                   else "   " + " " * 6)
+                + (f"  MFU {r['mfu'] * 100:.1f}%" if r.get("mfu") else "")
+                + f"  [{r['file']}]")
+        if m["best_prior"] is not None:
+            verdict = (f"REGRESSED {m['drop_pct']:.1f}% below trailing best "
+                       f"{m['best_prior']:,.1f} (threshold "
+                       f"{report['threshold_pct']:g}%)"
+                       if m["regressed"] else
+                       f"ok: latest {m['latest']:,.1f} vs trailing best "
+                       f"{m['best_prior']:,.1f} "
+                       f"({-m['drop_pct']:+.1f}%)")
+            out(f"  -> {verdict}")
+        else:
+            out("  -> single point; nothing to judge")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*",
+                    help="bench JSONs (default: <repo>/BENCH_r*.json)")
+    ap.add_argument("--dir", default=ROOT,
+                    help="directory holding BENCH_r*.json (default: repo "
+                    "root)")
+    ap.add_argument("--headline", default="",
+                    help="a raw bench.py headline JSON for the run under "
+                    "test, appended as the newest point")
+    ap.add_argument("--threshold-pct", type=float, default=5.0,
+                    help="fail when the newest point drops more than this "
+                    "%% below the metric's trailing best (default 5)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any regressed metric (the CI gate; "
+                    "implied by --headline)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as one JSON object on stdout")
+    args = ap.parse_args(argv)
+
+    files = list(args.files) or sorted(
+        glob.glob(os.path.join(args.dir, "BENCH_r*.json")))
+    if args.headline:
+        files.append(args.headline)
+    if not files:
+        print(f"bench_track: no BENCH_r*.json under {args.dir} and no "
+              "files given", file=sys.stderr)
+        return 2
+    points = load_points(files)
+    if not points:
+        print("bench_track: no usable bench points", file=sys.stderr)
+        return 2
+    if args.headline and not any(p["file"] == os.path.basename(args.headline)
+                                 for p in points):
+        # the gate --headline implies must never silently judge only the
+        # history: a missing/corrupt run-under-test is itself a failure
+        print(f"bench_track: headline {args.headline} yielded no usable "
+              "point — the run under test cannot be judged", file=sys.stderr)
+        return 2
+    report = track(points, args.threshold_pct)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        render(report)
+    if (args.check or args.headline) and not report["ok"]:
+        bad = [k for k, m in report["metrics"].items() if m["regressed"]]
+        print(f"bench_track: REGRESSION in {bad}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

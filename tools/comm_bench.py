@@ -5,6 +5,7 @@ bucketed vs monolithic gradient sync, swept over sizes.
     JAX_PLATFORMS=cpu python tools/comm_bench.py --cpu-devices 8
     python tools/comm_bench.py --dims 2048,1024,4096 --iters 20   # on TPU
     python tools/comm_bench.py --ledger comm.jsonl                # + records
+    python tools/comm_bench.py --json            # machine-readable sweep
 
 Three per-size tables (stdlib + jax only):
 
@@ -22,6 +23,8 @@ Three per-size tables (stdlib + jax only):
 MEASURED per-dispatch seconds (these programs are pure communication, so
 device time == comm time — the one place the ledger's comm phase is exact
 rather than a probe estimate); query with tools/ledger_report.py.
+``--json`` prints the whole sweep as one JSON object on stdout (tables go
+to stderr) — the stable input format for the ROADMAP item-3 auto-tuner.
 """
 
 from __future__ import annotations
@@ -50,6 +53,9 @@ def _args(argv=None):
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force the CPU backend with N virtual devices "
                     "(no-op if the backend is already initialized)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the sweep as one JSON object on stdout "
+                    "(tables move to stderr)")
     return ap.parse_args(argv)
 
 
@@ -70,7 +76,7 @@ def _row(label: str, a: str, b: str, ta: float, tb: float) -> str:
             f"{b:>10}: {tb * 1e3:9.3f} ms   {a}/{b} = {ratio:5.2f}x")
 
 
-def bench_allreduce(mesh, sizes_mb, iters, emit):
+def bench_allreduce(mesh, sizes_mb, iters, emit, say=print, results=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -79,7 +85,7 @@ def bench_allreduce(mesh, sizes_mb, iters, emit):
     from tpu_dist.parallel.mesh import DATA_AXIS
 
     n = mesh.devices.size
-    print(f"\nallreduce (sum across {n} devices, per-device buffer):")
+    say(f"\nallreduce (sum across {n} devices, per-device buffer):")
     for mb in sizes_mb:
         elems = max(n, int(mb * 1e6 / 4))
         x = jnp.ones((elems,), jnp.float32)
@@ -94,11 +100,16 @@ def bench_allreduce(mesh, sizes_mb, iters, emit):
             f, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
         t_ring = _timeit(wrap(ring), (x,), iters)
         t_psum = _timeit(wrap(fused), (x,), iters)
-        print(_row(f"{mb:g} MB", "ring", "psum", t_ring, t_psum))
+        say(_row(f"{mb:g} MB", "ring", "psum", t_ring, t_psum))
+        if results is not None:
+            results.append({"bench": "allreduce", "size_mb": mb,
+                            "bytes": elems * 4, "ring_s": t_ring,
+                            "psum_s": t_psum})
         emit(f"allreduce_{mb:g}mb", t_ring, elems * 4)
 
 
-def bench_collective_matmul(mesh, dims, iters, emit):
+def bench_collective_matmul(mesh, dims, iters, emit, say=print,
+                            results=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -110,12 +121,12 @@ def bench_collective_matmul(mesh, dims, iters, emit):
 
     n = mesh.devices.size
     b = 4
-    print(f"\ncollective matmul (column+row Megatron pair over {n} shards, "
-          f"batch {b}):")
+    say(f"\ncollective matmul (column+row Megatron pair over {n} shards, "
+        f"batch {b}):")
     for spec in dims:
         L, D, F = (int(v) for v in spec.split(","))
         if L % n or F % n or D % n:
-            print(f"  {spec}: skipped (dims must divide the axis size {n})")
+            say(f"  {spec}: skipped (dims must divide the axis size {n})")
             continue
         rng = np.random.default_rng(0)
         x = jnp.asarray(rng.normal(size=(b, L, D)), jnp.float32)
@@ -144,11 +155,16 @@ def bench_collective_matmul(mesh, dims, iters, emit):
                                    rtol=2e-4, atol=2e-4)
         t_ring = _timeit(ring, (x, w1, w2), iters)
         t_gspmd = _timeit(gspmd, (x, w1, w2), iters)
-        print(_row(f"L{L} D{D} F{F}", "ring", "gspmd", t_ring, t_gspmd))
+        say(_row(f"L{L} D{D} F{F}", "ring", "gspmd", t_ring, t_gspmd))
+        if results is not None:
+            results.append({"bench": "collective_matmul",
+                            "dims": [L, D, F], "bytes": b * L * D * 4,
+                            "ring_s": t_ring, "gspmd_s": t_gspmd})
         emit(f"matmul_L{L}_D{D}_F{F}", t_ring, b * L * D * 4)
 
 
-def bench_grad_sync(mesh, sizes_mb, bucket_mb, iters, emit):
+def bench_grad_sync(mesh, sizes_mb, bucket_mb, iters, emit, say=print,
+                    results=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -157,8 +173,8 @@ def bench_grad_sync(mesh, sizes_mb, bucket_mb, iters, emit):
     from tpu_dist.parallel.overlap import bucketed_grad_sync
 
     n = mesh.devices.size
-    print(f"\ngradient sync across {n} replicas "
-          f"(bucketed @ {bucket_mb:g} MB vs monolithic psum):")
+    say(f"\ngradient sync across {n} replicas "
+        f"(bucketed @ {bucket_mb:g} MB vs monolithic psum):")
     for mb in sizes_mb:
         elems = max(n, int(mb * 1e6 / 4))
         # a realistic ragged tree: a big embedding-ish leaf + smaller ones
@@ -179,7 +195,11 @@ def bench_grad_sync(mesh, sizes_mb, bucket_mb, iters, emit):
             f, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False))
         t_b = _timeit(wrap(bucketed), (tree,), iters)
         t_m = _timeit(wrap(monolithic), (tree,), iters)
-        print(_row(f"{mb:g} MB tree", "bucketed", "monolithic", t_b, t_m))
+        say(_row(f"{mb:g} MB tree", "bucketed", "monolithic", t_b, t_m))
+        if results is not None:
+            results.append({"bench": "grad_sync", "size_mb": mb,
+                            "bucket_mb": bucket_mb, "bytes": elems * 4,
+                            "bucketed_s": t_b, "monolithic_s": t_m})
         emit(f"grad_sync_{mb:g}mb", t_b, elems * 4)
 
 
@@ -205,7 +225,11 @@ def main(argv=None) -> int:
         return 1
     data_mesh = make_mesh((n,), (DATA_AXIS,))
     model_mesh = make_mesh((n,), (MODEL_AXIS,))
-    print(f"devices: {n} x {jax.devices()[0].device_kind}")
+    # --json: the object owns stdout, the human tables move to stderr
+    say = ((lambda *a, **k: print(*a, file=sys.stderr, **k))
+           if args.json else print)
+    results: list = []
+    say(f"devices: {n} x {jax.devices()[0].device_kind}")
 
     ledger = None
     step_i = 0
@@ -235,15 +259,28 @@ def main(argv=None) -> int:
         step_i += 1
 
     t0 = time.perf_counter()
-    bench_allreduce(data_mesh, args.sizes_mb, args.iters, emit)
-    bench_collective_matmul(model_mesh, args.dims, args.iters, emit)
+    bench_allreduce(data_mesh, args.sizes_mb, args.iters, emit,
+                    say=say, results=results)
+    bench_collective_matmul(model_mesh, args.dims, args.iters, emit,
+                            say=say, results=results)
     bench_grad_sync(data_mesh, args.sizes_mb, args.bucket_mb, args.iters,
-                    emit)
+                    emit, say=say, results=results)
     if ledger is not None:
         ledger.emit("run_end", steps=step_i,
                     seconds=round(time.perf_counter() - t0, 3))
         ledger.close()
-        print(f"\nledger: {args.ledger}")
+        say(f"\nledger: {args.ledger}")
+    if args.json:
+        import json
+
+        print(json.dumps({
+            "devices": n,
+            "device_kind": jax.devices()[0].device_kind,
+            "iters": args.iters,
+            "bucket_mb": args.bucket_mb,
+            "seconds": round(time.perf_counter() - t0, 3),
+            "results": results,
+        }))
     return 0
 
 

@@ -3,19 +3,25 @@
 
     python tools/ledger_report.py run.jsonl            # summary
     python tools/ledger_report.py run.jsonl --tail 20  # + last N step lines
+    python tools/ledger_report.py run.jsonl --json     # machine-readable
 
 Renders: run identity (kind/mesh/devices/processes), per-phase time share
-(data wait vs dispatch vs device block across every step record), MFU and
-throughput trend (first/middle/last thirds), the epoch table, cross-host
-skew/straggler summary, numerical-health trips (obs.health), and any
-watchdog stall dumps; multi-process runs get a pointer at the merged
-Chrome trace (tools/trace_merge.py). Corrupt/truncated trailing lines —
+(data wait vs dispatch vs device block across every step record), the
+roofline section (obs.attr cost-model buckets vs measured device/comm
+seconds and MFU — where the non-MFU time goes), MFU and throughput trend
+(first/middle/last thirds), the epoch table, cross-host skew/straggler
+summary, numerical-health trips (obs.health), flight-recorder diagnosis
+bundles (obs.flightrec), and any watchdog stall dumps; multi-process runs
+get a pointer at the merged Chrome trace (tools/trace_merge.py). ``--json``
+prints the same summary as one JSON object (the stable input for
+dashboards and the ROADMAP auto-tuner). Corrupt/truncated trailing lines —
 crashed runs are exactly the ones inspected here — are skipped with a
 warning, never a crash. Pure stdlib + the ledger module — safe to run on
 a login host with no jax installed (obs.ledger imports nothing heavy).
 """
 
 import argparse
+import json
 import os
 import sys
 
@@ -47,7 +53,102 @@ def _thirds(xs):
                                    len(xs) // 2 - n // 2 + n]), _mean(xs[-n:])
 
 
+def _si(x, unit=""):
+    """Engineering-format a count (1.23 G, 45.6 M ...)."""
+    if x is None:
+        return "?"
+    for div, suf in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(x) >= div:
+            return f"{x / div:.2f} {suf}{unit}"
+    return f"{x:.0f} {unit}" if unit else f"{x:.0f}"
+
+
+def roofline(cost_models, hot, mfu_mean=None, out=print):
+    """The cost-model-vs-measured section: per-category flop/byte shares
+    with ideal (roofline) seconds per optimizer step, against the
+    measured per-step device block and comm estimate. ``hot`` is the
+    warm-excluded step list summarize() already built (one filtering
+    rule, not two). Returns the machine-readable dict (also embedded in
+    --json output).
+
+    The cost model counts a scan (window) body ONCE, so its totals read
+    as one optimizer step — the same per-step units the measured side is
+    divided down to."""
+    if not cost_models:
+        return None
+    cm = cost_models[-1]  # the last compile is the program that trained
+    buckets = cm.get("buckets") or {}
+    if not buckets:
+        return None
+    peak_tf = cm.get("peak_tflops") or 0.0
+    peak_gb = cm.get("peak_gbps") or 0.0
+    tot_f = cm.get("total_flops") or sum(
+        b.get("flops") or 0 for b in buckets.values())
+    tot_b = cm.get("total_bytes") or sum(
+        b.get("bytes") or 0 for b in buckets.values())
+    nominal = bool(cm.get("peak_is_nominal"))
+
+    def ideal_s(flops, nbytes):
+        t_c = flops / (peak_tf * 1e12) if peak_tf else None
+        t_m = nbytes / (peak_gb * 1e9) if peak_gb else None
+        if t_c is None and t_m is None:
+            return None, "?"
+        if (t_c or 0) >= (t_m or 0):
+            return t_c, "compute"
+        return t_m, "memory"
+
+    n_opt = sum(r.get("steps_in_dispatch") or 1 for r in hot) or 1
+    dev_s = sum(r.get("device_s") or 0 for r in hot) / n_opt
+    comm_s = sum(r.get("comm_s") or 0 for r in hot) / n_opt
+    mfu = mfu_mean
+
+    out(f"\nroofline (cost model vs measured, program "
+        f"{cm.get('program')!r}"
+        + (", NOMINAL peaks" if nominal else "") + "):")
+    out(f"  {'category':<26} {'flops%':>7} {'bytes%':>7} "
+        f"{'ideal s/step':>13}  bound")
+    rows = {}
+    for cat in sorted(buckets, key=lambda c: -(buckets[c].get("flops") or 0)):
+        b = buckets[cat]
+        f, by = b.get("flops") or 0.0, b.get("bytes") or 0.0
+        t, bound = ideal_s(f, by)
+        if cat.startswith("collective:"):
+            bound = "comm"
+        rows[cat] = {"flops": f, "bytes": by, "flops_share":
+                     f / tot_f if tot_f else None,
+                     "bytes_share": by / tot_b if tot_b else None,
+                     "ideal_s": t, "bound": bound}
+        out(f"  {cat:<26} {f / tot_f * 100 if tot_f else 0:6.1f}% "
+            f"{by / tot_b * 100 if tot_b else 0:6.1f}% "
+            + (f"{t:13.3g}" if t is not None else f"{'?':>13}")
+            + f"  {bound}")
+    ideal_total, _ = ideal_s(tot_f, tot_b)
+    coll_b = sum(b.get("bytes") or 0 for c, b in buckets.items()
+                 if c.startswith("collective:"))
+    out(f"  model total {_si(tot_f, 'FLOP')} + {_si(tot_b, 'B')} per step"
+        + (f" -> ideal {ideal_total:.3g} s/step" if ideal_total else ""))
+    gap = dev_s / ideal_total if ideal_total and dev_s else None
+    if dev_s:
+        out(f"  measured: device {dev_s:.3g} s/step"
+            + ((f" = {gap:,.0f}x ideal" if gap >= 10 else
+                f" = {gap:.2f}x ideal") if gap else "")
+            + (f"; MFU {_fmt_mfu(mfu)} (mean)" if mfu is not None else ""))
+    if comm_s and coll_b:
+        out(f"  comm: measured {comm_s:.3g} s/step vs {_si(coll_b, 'B')} "
+            f"collective -> {coll_b / comm_s / 1e9:.2f} GB/s effective")
+    return {"program": cm.get("program"), "categories": rows,
+            "total_flops": tot_f, "total_bytes": tot_b,
+            "collective_bytes": coll_b, "ideal_s_per_step": ideal_total,
+            "measured_device_s_per_step": dev_s or None,
+            "measured_comm_s_per_step": comm_s or None,
+            "gap_vs_ideal": gap, "mfu_mean": mfu,
+            "peak_tflops": peak_tf or None, "peak_gbps": peak_gb or None,
+            "peak_is_nominal": nominal}
+
+
 def summarize(records, out=print):
+    """Render the summary through ``out`` and return the machine-readable
+    dict (--json prints it verbatim; the legacy count keys ride along)."""
     runs = [r for r in records if r["event"] == "run_start"]
     steps = [r for r in records if r["event"] == "step"]
     epochs = [r for r in records if r["event"] == "epoch"]
@@ -56,15 +157,25 @@ def summarize(records, out=print):
              and r.get("spread_s") is not None]
     stalls = [r for r in records if r["event"] == "stall"]
     healths = [r for r in records if r["event"] == "health"]
+    diags = [r for r in records if r["event"] == "diagnosis"]
+    cost_models = [r for r in records if r["event"] == "cost_model"]
     ends = [r for r in records if r["event"] == "run_end"]
+    summary = {"steps": len(steps), "epochs": len(epochs),
+               "skews": len(skews), "stalls": len(stalls),
+               "health": len(healths), "diagnosis": len(diags)}
 
     for r in runs:
         out(f"run: kind={r['kind']} devices={r.get('devices')} "
             f"mesh={r.get('mesh')} processes={r.get('process_count')}"
             + (" (MFU vs NOMINAL peak)" if r.get("peak_is_nominal") else ""))
+        summary["run"] = {k: r.get(k) for k in
+                          ("kind", "devices", "mesh", "process_count",
+                           "peak_tflops", "peak_is_nominal", "jax_version")}
     if ends:
         secs = ends[-1]["seconds"]
         status = ends[-1].get("status") or "ok"
+        summary["run_end"] = {"status": status, "steps": ends[-1]["steps"],
+                              "seconds": secs}
         out(f"{'CRASHED' if status == 'crashed' else 'completed'}: "
             f"{ends[-1]['steps']} steps in "
             + (f"{secs:.1f}s" if secs is not None else "?s")
@@ -87,6 +198,7 @@ def summarize(records, out=print):
         # comm_s OVERLAPS device_s (obs.ledger schema note): it reports
         # beside the share table, never inside its denominator
         total = tot["data_s"] + tot["dispatch_s"] + tot["device_s"] or 1.0
+        summary["phase_totals"] = tot
         out(f"\nsteps: {sum(r.get('steps_in_dispatch') or 1 for r in steps)} "
             f"optimizer steps in {len(steps)} records"
             + (f" ({warm_n} warm/compile record(s) excluded from shares)"
@@ -103,17 +215,23 @@ def summarize(records, out=print):
                 "growing LESS than comm_s when buckets/rings land)")
         tp = [r["throughput"] for r in hot if r["throughput"] is not None]
         mfu = [r["mfu"] for r in hot if r["mfu"] is not None]
+        summary["roofline"] = roofline(cost_models, hot,
+                                       mfu_mean=_mean(mfu), out=out)
         a, b, c = _thirds(tp)
         if a is not None:
             out(f"throughput ({hot[0]['unit']}): first/mid/last thirds "
                 f"{a:,.0f} / {b:,.0f} / {c:,.0f}")
+            summary["throughput"] = {"unit": hot[0]["unit"], "thirds":
+                                     [a, b, c], "mean": _mean(tp)}
         a, b, c = _thirds(mfu)
         if a is not None:
             out(f"MFU trend: {_fmt_mfu(a)} -> {_fmt_mfu(b)} -> {_fmt_mfu(c)}"
                 f"  (mean {_fmt_mfu(_mean(mfu))})")
+            summary["mfu"] = {"thirds": [a, b, c], "mean": _mean(mfu)}
 
     if epochs:
         out("\nepochs:")
+        summary["epoch_table"] = []
         for r in epochs:
             # schema-legal None values render as '?' (presence, not
             # non-nullness, is what the schema pins)
@@ -123,12 +241,17 @@ def summarize(records, out=print):
                 + (f" ppl={r['ppl']:.2f}" if r.get("ppl") else "")
                 + (f" acc1={r['acc1'] * 100:.2f}%" if r.get("acc1") is not None
                    else ""))
+            summary["epoch_table"].append(
+                {k: r.get(k) for k in ("epoch", "loss", "throughput", "unit",
+                                       "seconds", "ppl", "acc1")})
     if evals:
         last = evals[-1]
         out("last eval: loss=" + _num(last["loss"], ".4f")
             + (f" ppl={last['ppl']:.2f}" if last.get("ppl") else "")
             + (f" acc1={last['acc1'] * 100:.2f}%"
                if last.get("acc1") is not None else ""))
+        summary["last_eval"] = {k: last.get(k)
+                                for k in ("epoch", "loss", "ppl", "acc1")}
 
     if skews:
         worst = max(skews, key=lambda r: r["spread_s"])
@@ -140,6 +263,9 @@ def summarize(records, out=print):
             f"(straggler process {worst['straggler']}); "
             f"p50 {worst['p50_s'] * 1e3:.1f}ms p99 {worst['p99_s'] * 1e3:.1f}ms")
         out(f"straggler histogram (process: samples): {hist}")
+        summary["skew"] = {"worst_spread_s": worst["spread_s"],
+                           "straggler_histogram":
+                           {str(k): v for k, v in hist.items()}}
 
     if healths:
         kinds = {}
@@ -152,6 +278,18 @@ def summarize(records, out=print):
             out(f"  step {r.get('step')}: {r.get('kind')} "
                 f"value={r.get('value')} loss={r.get('loss')} "
                 f"-> {r.get('action')}")
+        summary["health_kinds"] = kinds
+
+    if diags:
+        out(f"\nDIAGNOSIS BUNDLES: {len(diags)} (obs.flightrec)")
+        summary["diagnosis_bundles"] = []
+        for r in diags:
+            out(f"  [{r.get('reason')}] step {r.get('step')} -> "
+                f"{r.get('bundle')} (trace: {r.get('trace')})"
+                + (f" — {r['note']}" if r.get("note") else ""))
+            summary["diagnosis_bundles"].append(
+                {k: r.get(k) for k in ("reason", "step", "bundle", "trace",
+                                       "note")})
 
     if stalls:
         out(f"\nWATCHDOG STALLS: {len(stalls)}")
@@ -160,8 +298,7 @@ def summarize(records, out=print):
                 f"{_num(r['threshold_s'], '.1f')}s) — first stack lines:")
             for line in (r.get("stacks") or "").splitlines()[:6]:
                 out(f"    {line}")
-    return {"steps": len(steps), "epochs": len(epochs), "skews": len(skews),
-            "stalls": len(stalls), "health": len(healths)}
+    return summary
 
 
 def main(argv=None) -> int:
@@ -169,6 +306,9 @@ def main(argv=None) -> int:
     ap.add_argument("path", help="ledger JSONL (obs.ledger)")
     ap.add_argument("--tail", type=int, default=0,
                     help="also render the last N step records as lines")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON object on stdout "
+                    "(human render suppressed)")
     args = ap.parse_args(argv)
     # strict=False: a crashed writer leaves a torn trailing line, and a
     # crashed run is exactly the one being inspected — warn, don't raise
@@ -176,6 +316,10 @@ def main(argv=None) -> int:
     if not records:
         print(f"{args.path}: empty ledger", file=sys.stderr)
         return 1
+    if args.json:
+        summary = summarize(records, out=lambda s: None)
+        print(json.dumps(summary, default=str))
+        return 0
     summarize(records)
     if args.tail:
         print(f"\nlast {args.tail} step records:")

@@ -148,6 +148,13 @@ class TrainConfig:
     metrics_port: int = 0              # Prometheus scrape endpoint
                                        # (obs.metrics): process i serves
                                        # http://host:(port+i)/metrics; 0=off
+    flightrec_dir: str = ""            # flight-recorder bundle root
+                                       # (obs.flightrec); "" derives
+                                       # <ledger_path>.flightrec (or a temp
+                                       # dir) at first trigger
+    flightrec_trace_steps: int = 3     # jax.profiler window: step records
+                                       # captured after a trigger (0 = no
+                                       # trace in the bundle)
 
     # -- synthetic-data knobs (TPU-only: zero-egress envs can't download datasets)
     synth_train_size: int = 50000
@@ -290,6 +297,11 @@ class LMConfig:
     health_spike_z: float = 8.0    # loss-spike z-score threshold (0 = off)
     metrics_port: int = 0          # Prometheus scrape endpoint: process i
                                    # serves port+i (obs.metrics; 0 = off)
+    flightrec_dir: str = ""        # flight-recorder bundle root
+                                   # (obs.flightrec; "" derives from
+                                   # ledger_path or a temp dir)
+    flightrec_trace_steps: int = 3 # profiler window after a trigger, in
+                                   # step records (0 = no trace)
 
 
 def add_args(parser: argparse.ArgumentParser, defaults) -> None:

@@ -746,12 +746,19 @@ class LMTrainer:
                 # single-dispatch runs
                 from tpu_dist.utils.telemetry import program_stats
                 st = program_stats(self.train_step, self.state, inputs_d,
-                                   targets_d, self.rng)
+                                   targets_d, self.rng,
+                                   with_hlo=bool(self.obs.ledger.path))
                 self._program_hbm = st["hbm_bytes"] or False
                 self.obs.ledger.emit(
                     "compile", program="train_step",
                     seconds=warm_secs or None,
                     hbm_bytes=st["hbm_bytes"], flops=st["flops"])
+                if st.get("hlo"):
+                    # static cost attribution of the same executable (one
+                    # lower for hbm/flops/buckets — obs.attr roofline)
+                    from tpu_dist.obs.attr import emit_cost_model
+                    emit_cost_model(self.obs.ledger, "train_step",
+                                    st["hlo"], xla_flops=st["flops"])
             pending.append((metrics, {
                 "step": gstep, "n_steps": 1, "n_items": tokens_per_batch,
                 "data_s": data_s, "dispatch_s": dispatch_s,
@@ -838,12 +845,18 @@ class LMTrainer:
                 # runs record it too): see telemetry.program_stats
                 from tpu_dist.utils.telemetry import program_stats
                 st = program_stats(self.window_step, self.state,
-                                   self._train_rows_dev, idx_dev, self.rng)
+                                   self._train_rows_dev, idx_dev, self.rng,
+                                   with_hlo=bool(self.obs.ledger.path))
                 self._program_hbm = st["hbm_bytes"] or False
                 self.obs.ledger.emit(
                     "compile", program="window_step",
                     seconds=warm_secs or None,
                     hbm_bytes=st["hbm_bytes"], flops=st["flops"])
+                if st.get("hlo"):
+                    # static cost attribution (obs.attr), same executable
+                    from tpu_dist.obs.attr import emit_cost_model
+                    emit_cost_model(self.obs.ledger, "window_step",
+                                    st["hlo"], xla_flops=st["flops"])
             done += n
             pending.append((metrics, {
                 "step": epoch * self.steps_per_epoch + done - 1,

@@ -530,12 +530,20 @@ class Trainer:
                 # even a single-dispatch run still records the column
                 from tpu_dist.utils.telemetry import program_stats
                 st = program_stats(self.train_step, self.state, images,
-                                   labels, self.rng)
+                                   labels, self.rng,
+                                   with_hlo=bool(self.obs.ledger.path))
                 self._program_hbm = st["hbm_bytes"] or False
                 self._program_flops = st["flops"]
                 self.obs.ledger.emit("compile", program="train_step",
                                      hbm_bytes=st["hbm_bytes"],
                                      flops=st["flops"])
+                if st.get("hlo"):
+                    # static cost attribution of the same executable (one
+                    # lower for hbm/flops/buckets — obs.attr); feeds the
+                    # ledger_report roofline section
+                    from tpu_dist.obs.attr import emit_cost_model
+                    emit_cost_model(self.obs.ledger, "train_step",
+                                    st["hlo"], xla_flops=st["flops"])
             pending.append((metrics, {
                 "step": gstep, "n_steps": 1, "n_items": cfg.batch_size,
                 "data_s": data_s, "dispatch_s": dispatch_s,
@@ -667,12 +675,18 @@ class Trainer:
                 from tpu_dist.utils.telemetry import program_stats
                 args = ((*self._train_data_dev, dev_payload, self.rng)
                         if self.device_data else (*dev_payload, self.rng))
-                st = program_stats(self.window_step, self.state, *args)
+                st = program_stats(self.window_step, self.state, *args,
+                                   with_hlo=bool(self.obs.ledger.path))
                 self._program_hbm = st["hbm_bytes"] or False
                 self._program_flops = st["flops"]
                 self.obs.ledger.emit("compile", program="window_step",
                                      hbm_bytes=st["hbm_bytes"],
                                      flops=st["flops"])
+                if st.get("hlo"):
+                    # static cost attribution (obs.attr), same executable
+                    from tpu_dist.obs.attr import emit_cost_model
+                    emit_cost_model(self.obs.ledger, "window_step",
+                                    st["hlo"], xla_flops=st["flops"])
             done += n
             pending.append((metrics, {
                 "step": epoch * self.steps_per_epoch + done - 1,

@@ -34,6 +34,8 @@ import time
 import traceback
 from typing import Optional
 
+from tpu_dist.obs.attr import bucket_totals, cost_buckets, emit_cost_model
+from tpu_dist.obs.flightrec import FlightRecorder
 from tpu_dist.obs.health import HealthError, HealthSentry, validate_health
 from tpu_dist.obs.ledger import (EVENT_SCHEMA, EpochCsvSink, Ledger,
                                  ProgressSink, per_process_path, phase_totals,
@@ -44,9 +46,10 @@ from tpu_dist.obs.skew import SkewMonitor
 from tpu_dist.obs.trace import StepTracer, profile_session, step_annotation
 from tpu_dist.obs.watchdog import Watchdog
 
-__all__ = ["EVENT_SCHEMA", "EpochCsvSink", "HealthError", "HealthSentry",
-           "Ledger", "MetricsRegistry", "ProgressSink",
+__all__ = ["EVENT_SCHEMA", "EpochCsvSink", "FlightRecorder", "HealthError",
+           "HealthSentry", "Ledger", "MetricsRegistry", "ProgressSink",
            "RunObs", "SkewMonitor", "StepTracer", "Watchdog",
+           "bucket_totals", "cost_buckets", "emit_cost_model",
            "metrics_ledger_sink", "per_process_path", "phase_totals",
            "profile_session", "read_ledger", "serve_metrics",
            "step_annotation"]
@@ -114,6 +117,20 @@ class RunObs:
             # .pN story for ports: process i serves metrics_port + i
             self.metrics_server = serve_metrics(self.metrics,
                                                 metrics_port + pidx)
+        # flight recorder (obs.flightrec): always-on ring of recent events
+        # + triggered bundle capture, fed — like the metrics registry — by
+        # the one ledger event stream, so watchdog stalls, health trips and
+        # skew-straggler spikes all produce a bundle without new plumbing.
+        # The profiler-window veto keeps it off the global profiler when a
+        # profile_dir session owns it.
+        self.flightrec = FlightRecorder(
+            dir=getattr(cfg, "flightrec_dir", "") or "",
+            ledger=self.ledger,
+            trace_steps=getattr(cfg, "flightrec_trace_steps", 3),
+            profiler_busy=lambda: self.profiling,
+            process_index=pidx)
+        self.ledger.add_sink(self.flightrec.sink)
+        self._prev_sigusr1 = None
         self.peak_tflops, self.peak_is_nominal = effective_peak_tflops()
         self._mesh_info = (
             {name: int(size) for name, size in mesh.shape.items()}
@@ -140,7 +157,8 @@ class RunObs:
             process_count=jax.process_count(),
             device_count=jax.device_count(),
             peak_tflops=self.peak_tflops,
-            peak_is_nominal=self.peak_is_nominal)
+            peak_is_nominal=self.peak_is_nominal,
+            jax_version=jax.__version__)
         self._arm_crash_guard()
 
     def run_end(self, status: Optional[str] = None, **extra) -> None:
@@ -158,6 +176,9 @@ class RunObs:
         self._disarm_crash_guard()
         if self.watchdog is not None:
             self.watchdog.stop()
+        # finalize a profiler window left open (a stall with no subsequent
+        # steps) BEFORE the final emits below land in the ring
+        self.flightrec.close()
         if status is None:
             exc = sys.exc_info()[1]
             if exc is None and self._crash_tb is not None:
@@ -201,6 +222,14 @@ class RunObs:
                                                    self._on_sigterm)
         except (ValueError, OSError):  # non-main thread / exotic platform
             self._prev_sigterm = None
+        try:
+            # operator-initiated diagnosis: kill -USR1 <pid> captures a
+            # flight-recorder bundle without touching the run
+            if threading.current_thread() is threading.main_thread():
+                self._prev_sigusr1 = signal.signal(signal.SIGUSR1,
+                                                   self._on_sigusr1)
+        except (ValueError, OSError, AttributeError):  # no SIGUSR1 on win
+            self._prev_sigusr1 = None
 
     def _disarm_crash_guard(self) -> None:
         try:
@@ -216,6 +245,18 @@ class RunObs:
             except (ValueError, OSError):
                 pass
             self._prev_sigterm = None
+        if self._prev_sigusr1 is not None:
+            try:
+                signal.signal(signal.SIGUSR1, self._prev_sigusr1)
+            except (ValueError, OSError):
+                pass
+            self._prev_sigusr1 = None
+
+    def _on_sigusr1(self, signum, frame) -> None:
+        self.flightrec.trigger("sigusr1")
+        prev = self._prev_sigusr1
+        if callable(prev):
+            prev(signum, frame)
 
     def _excepthook(self, exc_type, exc, tb) -> None:
         # record the traceback for the atexit emit, then defer to the

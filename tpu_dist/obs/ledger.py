@@ -70,6 +70,15 @@ EVENT_SCHEMA = {
     # numerical-health trip (obs.health sentry: non-finite grads/loss or a
     # loss spike); action records what the policy did (record|skip|halt)
     "health": ("step", "kind", "policy", "action", "value"),
+    # flight-recorder bundle captured (obs.flightrec): reason names the
+    # trigger (stall|health|skew|sigusr1|manual), bundle the directory
+    # holding manifest.json + stacks/HBM/ledger-tail/profiler-window
+    "diagnosis": ("reason", "bundle", "step"),
+    # static cost attribution of one compiled step program (obs.attr):
+    # buckets maps category -> {flops, bytes, count}; emitted once at
+    # compile time beside the 'compile' event, read back by the
+    # ledger_report roofline section
+    "cost_model": ("program", "buckets"),
     # final registry dump (obs.metrics) so counter values survive in the
     # flight record after the scrape endpoint is gone
     "metrics_snapshot": ("metrics",),

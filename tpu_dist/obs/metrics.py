@@ -275,6 +275,14 @@ def metrics_ledger_sink(reg: MetricsRegistry):
                          "numerical-health trips by kind")
     health.labels(kind="nonfinite")       # pre-register: scrape shows 0
     health.labels(kind="loss_spike")
+    # build_info-style identity gauge (value always 1; the labels are the
+    # payload): scrapes from different runs/configs become joinable on
+    # run_id/config_hash, Prometheus-standard style. The family is
+    # pre-registered here; its one child materializes when run_start
+    # carries the labels.
+    build_info = reg.gauge("tpu_dist_build_info",
+                           "run identity: join metrics across runs on "
+                           "these labels (value is always 1)")
     epoch_g = reg.gauge("tpu_dist_epoch", "last completed epoch")
     eval_loss = reg.gauge("tpu_dist_eval_loss", "last held-out eval loss")
     hbm = reg.gauge("tpu_dist_hbm_bytes_in_use", "last HBM sampler reading")
@@ -289,7 +297,21 @@ def metrics_ledger_sink(reg: MetricsRegistry):
 
     def sink(rec: dict) -> None:
         ev = rec.get("event")
-        if ev == "step":
+        if ev == "run_start":
+            import hashlib
+            import json as _json
+
+            cfg = rec.get("config") or {}
+            chash = hashlib.sha1(_json.dumps(
+                cfg, sort_keys=True, default=str).encode()).hexdigest()[:12]
+            build_info.labels(
+                run_id=f"{int(rec.get('ts') or 0)}-p{rec.get('pid', 0)}",
+                kind=str(rec.get("kind") or ""),
+                config_hash=chash,
+                jax=str(rec.get("jax_version") or ""),
+                quant=str(cfg.get("quant") or "none"),
+                tp_impl=str(cfg.get("tp_impl") or "gspmd")).set(1)
+        elif ev == "step":
             n = rec.get("steps_in_dispatch") or 1
             steps.inc(n)
             if rec.get("items"):
@@ -351,10 +373,17 @@ class MetricsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API name)
-                body = reg.render().encode()
+                if self.path.split("?")[0] in ("/healthz", "/livez"):
+                    # trivial liveness: the process (and this daemon
+                    # thread) is up — no registry render, so a wedged
+                    # metrics pipeline can't fail the liveness probe
+                    body = b"ok\n"
+                    ctype = "text/plain; charset=utf-8"
+                else:
+                    body = reg.render().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

@@ -8,7 +8,6 @@ numbers per chip; override with BENCH_PEAK_TFLOPS for unlisted devices.
 from __future__ import annotations
 
 import os
-import sys
 
 # bf16 peak TFLOP/s per chip by device kind (public spec sheets)
 PEAK_TFLOPS = (
@@ -95,14 +94,6 @@ def moe_lm_flops_per_token(params, num_layers: int, seq_len: int,
     return dense + experts + routing
 
 
-def step_flops(jitted_step, *args) -> float | None:
-    """One step's FLOPs from XLA's cost model (per-device SPMD program);
-    None when the backend doesn't expose cost analysis."""
-    try:
-        cost = jitted_step.lower(*args).compile().cost_analysis()
-        if isinstance(cost, list):  # older API: one dict per device program
-            cost = cost[0]
-        return float(cost["flops"])
-    except Exception as e:
-        print(f"cost_analysis unavailable: {e!r}", file=sys.stderr)
-        return None
+# (the former step_flops() XLA-cost-model probe lives in
+# utils.telemetry.program_stats now — one AOT lower for flops/hbm/HLO
+# together; its last caller, bench.py, moved there in round 10)

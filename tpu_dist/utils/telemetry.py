@@ -64,20 +64,33 @@ def program_hbm_bytes(jitted_fn, *args) -> Optional[int]:
     return program_stats(jitted_fn, *args)["hbm_bytes"]
 
 
-def program_stats(jitted_fn, *args) -> dict:
-    """{'hbm_bytes', 'flops'} of ONE compiled program in ONE AOT
+def program_stats(jitted_fn, *args, with_hlo: bool = False) -> dict:
+    """{'hbm_bytes', 'flops'[, 'hlo']} of ONE compiled program in ONE AOT
     lower+compile (both the buffer assignment and the cost model read the
     same executable, so probing them together halves the — cached, but not
     free — lowering work). Same post-dispatch call-order contract as
     :func:`program_hbm_bytes`. Either value is None when the backend does
     not expose it; on a multi-step (lax.scan) window program the cost
     model counts the scan body ONCE, so ``flops`` approximates one
-    optimizer step's FLOPs there, not the window's."""
+    optimizer step's FLOPs there, not the window's.
+
+    ``with_hlo=True`` additionally returns the OPTIMIZED (post-fusion) HLO
+    text of the same executable under ``'hlo'`` — the input to
+    :func:`tpu_dist.obs.attr.cost_buckets` — so cost attribution reuses
+    this probe's lower+compile instead of paying its own. Off by default:
+    the text can run to megabytes on real step programs."""
     out = {"hbm_bytes": None, "flops": None}
+    if with_hlo:
+        out["hlo"] = None
     try:
         compiled = jitted_fn.lower(*args).compile()
     except Exception:
         return out
+    if with_hlo:
+        try:
+            out["hlo"] = compiled.as_text()
+        except Exception:
+            pass
     try:
         ma = compiled.memory_analysis()
         out["hbm_bytes"] = int(
