@@ -58,30 +58,52 @@ NUM_CLASSES = int(os.environ.get("BENCH_NUM_CLASSES", "10"))
 
 
 def bench_ledger(kind: str, config: dict):
-    """(ledger, path) when BENCH_LEDGER names a JSONL path, else (None,
-    None): the bench feeds the SAME obs.ledger event stream the engines
-    write — run_start with the BENCH_* geometry, one 'step' per timed
-    trial with the dispatch/device phase split, run_end — so bench runs
-    are queryable with tools/ledger_report.py like any training run.
+    """(ledger, path, goodput_acc) when BENCH_LEDGER names a JSONL path,
+    else (None, None, None): the bench feeds the SAME obs.ledger event
+    stream the engines write — run_start with the BENCH_* geometry, one
+    'step' per timed trial with the dispatch/device phase split, run_end
+    — so bench runs are queryable with tools/ledger_report.py like any
+    training run. A GoodputAccumulator rides as a sink so the headline
+    JSON carries the run's wall-clock partition (the 'goodput' block).
     The LM bench emits live (plus a 'compile' event for the warm
     dispatch); the image path constructs the ledger only after measure()
     returns and emits its trial records retrospectively, so its 'ts'
     stamps are end-of-run and it carries no 'compile' event."""
     path = os.environ.get("BENCH_LEDGER", "")
     if not path:
-        return None, None
+        return None, None, None
     import jax
 
-    from tpu_dist.obs import Ledger, effective_peak_tflops
+    from tpu_dist.obs import GoodputAccumulator, Ledger, effective_peak_tflops
 
     eff_peak, nominal = effective_peak_tflops()
     ledger = Ledger(path)
+    acc = GoodputAccumulator()
+    ledger.add_sink(acc.add)
     ledger.emit("run_start", kind=kind, config=config, mesh=None,
                 devices=sorted({d.device_kind for d in jax.local_devices()}),
                 process_count=jax.process_count(),
                 device_count=jax.device_count(),
                 peak_tflops=eff_peak, peak_is_nominal=nominal)
-    return ledger, path
+    return ledger, path, acc
+
+
+def goodput_block(acc):
+    """Headline-JSON goodput block from the bench ledger's accumulator.
+    None without BENCH_LEDGER (no partition without an event stream) AND
+    on the image bench's retrospective path: its records are all emitted
+    after measure() returns, so the timestamp span is milliseconds while
+    the itemized phase seconds are real — the overrun guard below refuses
+    to publish that nonsense ratio rather than hide it."""
+    part = acc.finalize() if acc is not None else None
+    if not part:
+        return None
+    if part["overrun_s"] > 0.5 * part["wall_s"]:
+        return None
+    return {"ratio": part["ratio"], "wall_s": part["wall_s"],
+            "goodput_s": part["goodput_s"],
+            "overrun_s": part["overrun_s"],
+            "categories": part["categories"]}
 
 
 def lm_geometry():
@@ -264,7 +286,8 @@ def lm_bench():
     # analytical model FLOPs (tpu_dist.utils.mfu.lm_flops_per_token; XLA's
     # cost model undercounts scan bodies and cannot cost Pallas kernels)
     flops_per_token = lm_flops_per_token(b["params"], layers, L, d_model)
-    ledger, ledger_path = bench_ledger("bench_lm", lm_geometry())
+    ledger, ledger_path, goodput_acc = bench_ledger("bench_lm",
+                                                    lm_geometry())
     t_warm = time.perf_counter()
     state, m = window(state, rows_dev, idx_dev, key)           # compile+warm
     jax.device_get(m)
@@ -361,6 +384,7 @@ def lm_bench():
         "tflops": round(tflops, 2) if tflops else None,
         "phases": best_phases,
         "health": health,
+        "goodput": goodput_block(goodput_acc),
         "ledger": ledger_path,
     }))
 
@@ -564,7 +588,7 @@ def main():
                          with_hlo=bool(os.environ.get("BENCH_LEDGER")))
     ips_per_chip, tflops, mfu, fpi = report("headline", best, rates,
                                             window_flops, batch)
-    ledger, ledger_path = bench_ledger(
+    ledger, ledger_path, goodput_acc = bench_ledger(
         "bench_image", {"arch": ARCH, "img": IMG, "classes": NUM_CLASSES,
                         "per_chip_batch": per_chip_batch, "k": k,
                         **{kk: getattr(v, "__name__", str(v))
@@ -615,6 +639,7 @@ def main():
             "flops_per_img": round(fpi) if fpi else None,
             "phases": phases,
             "health": health,
+            "goodput": goodput_block(goodput_acc),
             "ledger": ledger_path,
         }))
         return
@@ -649,6 +674,7 @@ def main():
         "flops_per_img": round(fpi) if fpi else None,
         "phases": phases,
         "health": health,
+        "goodput": goodput_block(goodput_acc),
         "ledger": ledger_path,
     }))
 

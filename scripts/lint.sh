@@ -7,7 +7,9 @@
 # DL006 (the absorbed tools/check_ledger_schema) covers every emit site in
 # the union of these two invocations — including the round-9 ones: the
 # health sentry (tpu_dist/obs/health.py), the metrics snapshot
-# (tpu_dist/obs/__init__.py), and the trace-merge/report readers in tools/.
+# (tpu_dist/obs/__init__.py), the trace-merge/report readers in tools/,
+# and the round-11 'goodput'/'slo' emitters (tpu_dist/obs/goodput.py,
+# tools/decode_bench.py) — the tree must stay at 0 findings.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -18,3 +20,26 @@ python -m tools.distlint --select DL006 tests scripts
 # checked-in BENCH_r*.json must not have dropped >5% below the metric's
 # trailing best — the apex-data_prefetcher class of silent regression.
 python tools/bench_track.py --check
+
+# Advisory tier-1 budget creep warning (never fails the gate): conftest
+# writes each full-suite run's wall time + top-20 durations to
+# /tmp/tier1_durations.json (TPU_DIST_TIER1_DURATIONS overrides); the
+# suite dies at the 870s timeout, so a wall beyond 700s deserves eyes on
+# the top offenders BEFORE the timeout rediscovers it the hard way.
+python - <<'EOF' || true
+import json, os
+path = os.environ.get("TPU_DIST_TIER1_DURATIONS", "/tmp/tier1_durations.json")
+try:
+    with open(path) as f:
+        d = json.load(f)
+except Exception:
+    raise SystemExit(0)  # no recorded run on this machine — nothing to say
+wall = d.get("wall_s") or 0
+if wall > 700:
+    print(f"WARNING: last tier-1 run took {wall:.0f}s of the 870s budget "
+          f"({d.get('tests', '?')} tests; advisory only). Top offenders:")
+    for t in (d.get("top") or [])[:8]:
+        print(f"  {t.get('s', 0):7.1f}s  {t.get('nodeid', '?')}")
+    print("  -> slow-mark new heavy tests (pyproject 'slow' marker) or "
+          "shrink the biggest ones above.")
+EOF

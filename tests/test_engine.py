@@ -310,6 +310,7 @@ def test_trainer_windowed_device_data_matches_per_batch(tmp_path):
     np.testing.assert_allclose(p1, p4, rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 7): near-duplicate of the device-data windowed parity (already slow); windowed train+eval stay exercised in-budget by test_windowed_eval_matches_host_eval
 def test_trainer_windowed_host_mode_matches_per_batch(tmp_path):
     """steps_per_dispatch=2 with host-stacked windows (tail window of 1)."""
     _, p1 = _trainer_params(str(tmp_path / "a"), k=1)

@@ -101,6 +101,7 @@ def test_lm_shard_mode_windowed_matches_per_batch(mesh_kw):
     assert tr4.best_ppl == pytest.approx(tr1.best_ppl, rel=1e-4)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 7): 22s parity twin; grad-accum stays covered in-budget by the image-side test_trainer_grad_accum_wiring
 def test_lm_grad_accum_matches_full_batch():
     """--grad-accum-steps N: N sequential microbatches averaging into ONE
     update must equal the full-batch step (dropout-free model), and the

@@ -180,6 +180,7 @@ def test_pp_multiple_steps_converge():
     assert losses == sorted(losses, reverse=True), losses  # monotone descent
 
 
+@pytest.mark.slow  # tier-1 budget (PR 7): 27s memory-property compile; 1f1b stays covered by pp_step_matches_dp[1f1b] + the loss_chunk parity
 def test_pp_1f1b_activation_memory_independent_of_microbatches():
     """THE 1F1B property: compiled temp (activation) memory is flat in M,
     while GPipe-by-autodiff grows linearly (it stashes every tick input).

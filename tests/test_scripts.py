@@ -147,6 +147,7 @@ def test_tool_data_rate(tmp_path):
     assert "host_data_path_images_per_sec" in out
 
 
+@pytest.mark.slow  # tier-1 budget (PR 7): 14s end-to-end sampler run; the sampler/peak-HBM mechanics stay covered by test_telemetry.py units
 def test_telemetry_csv_and_peak_hbm_column(tmp_path):
     """--telemetry-csv samples the 500ms device/host CSV (reference
     statistics.sh analog, C22) and the per-epoch CSV carries the peak-HBM

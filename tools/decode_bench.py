@@ -8,9 +8,18 @@ embeds ONE token per tick and attends over the cache (O(L*d) per token);
 the full-recompute path re-runs the whole prefix every tick (O(L^2*d)) —
 this tool puts the factor between them on record.
 
+``--requests N`` additionally runs N sequential warm KV-cache calls as
+individual *requests* and reports per-request latency percentiles
+(p50/p99) plus request tok/s in the headline JSON — the first
+scrape-able serving SLO. With ``--ledger`` (or ``BENCH_LEDGER``) each
+request lands as one ``decode`` ledger event, so
+``tools/ledger_report.py`` renders the same percentiles in its decode
+section.
+
 Usage:
     python tools/decode_bench.py                         # both paths
     python tools/decode_bench.py --steps 512 --batch 16
+    python tools/decode_bench.py --requests 16 --ledger dec.jsonl
 """
 
 import json
@@ -52,6 +61,13 @@ def main():
                     help="skip the O(L^2) full-recompute reference "
                          "(slow at long totals)")
     ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=8,
+                    help="sequential warm kv-cache calls timed as "
+                         "individual requests for the latency percentiles "
+                         "(0 = skip the per-request section)")
+    ap.add_argument("--ledger", default=os.environ.get("BENCH_LEDGER", ""),
+                    help="JSONL run ledger: one 'decode' event per request "
+                         "(tools/ledger_report.py renders p50/p99 from it)")
     args = ap.parse_args()
 
     import jax
@@ -136,6 +152,19 @@ def main():
         toks = args.batch * args.steps
         return toks / best, best / ticks * 1e3, out
 
+    ledger = None
+    if args.ledger:
+        from tpu_dist.obs.ledger import Ledger
+        ledger = Ledger(args.ledger)
+        ledger.emit("run_start", kind="decode_bench",
+                    config={k: v for k, v in vars(args).items()
+                            if not callable(v)},
+                    mesh=({"tp": args.tp, "dp": args.dp}
+                          if args.tp or args.dp else None),
+                    devices=sorted({d.device_kind
+                                    for d in jax.local_devices()}),
+                    process_count=jax.process_count())
+
     cache_rate, cache_ms, out_c = timed(True)
     print(f"kv-cache decode: {cache_rate:,.0f} generated-tok/s incl. "
           f"batched prefill ({cache_ms:.2f} ms/generated token, "
@@ -157,6 +186,35 @@ def main():
                   f"(random-weight near-ties; see tests/test_generate.py "
                   f"for the exact-equality contract)", file=sys.stderr)
 
+    # -- per-request serving latency (the first scrape-able serving SLO):
+    # N sequential warm kv-cache calls, each timed as one request; the
+    # nearest-rank percentiles match tools/ledger_report.decode_section
+    latency = None
+    req_tok_s = None
+    if args.requests > 0:
+        lat = []
+        for _ in range(args.requests):
+            t0 = time.perf_counter()
+            out_r = generate(model, params, prompt, args.steps,
+                             temperature=args.temperature, use_cache=True,
+                             top_k=args.top_k, top_p=args.top_p, mesh=mesh,
+                             ledger=ledger)
+            jax.device_get(out_r)  # completion forced (same tunnel caveat)
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        pick = lambda q: lat[min(int(round(q / 100.0 * (len(lat) - 1))),
+                                 len(lat) - 1)]
+        latency = {"p50_ms": round(pick(50) * 1e3, 3),
+                   "p99_ms": round(pick(99) * 1e3, 3)}
+        req_tok_s = round(args.batch * args.steps * len(lat) / sum(lat), 1)
+        print(f"requests: {len(lat)} sequential kv-cache calls, "
+              f"{req_tok_s:,.0f} tok/s; latency p50 {latency['p50_ms']:.1f}"
+              f"ms / p99 {latency['p99_ms']:.1f}ms", file=sys.stderr)
+    if ledger is not None:
+        ledger.emit("run_end", steps=args.requests,
+                    seconds=round(sum(lat), 3) if latency else 0.0)
+        ledger.close()
+
     print(json.dumps({
         "metric": "lm_decode_tokens_per_sec",
         "kv_cache": round(cache_rate, 1),
@@ -169,6 +227,10 @@ def main():
         "temperature": args.temperature, "top_k": args.top_k,
         "top_p": args.top_p, "tp": args.tp, "dp": args.dp,
         "num_experts": args.num_experts,
+        "requests": args.requests or None,
+        "latency_ms": latency,
+        "request_tokens_per_sec": req_tok_s,
+        "ledger": args.ledger or None,
     }))
 
 

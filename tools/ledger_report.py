@@ -7,12 +7,22 @@
 
 Renders: run identity (kind/mesh/devices/processes), per-phase time share
 (data wait vs dispatch vs device block across every step record), the
+goodput section (obs.goodput: wall-clock partitioned into goodput and the
+badput categories — startup/compile, data wait, dispatch, eval, ckpt,
+stalls, health-skipped steps, idle residue, restart gaps — summing to
+100% of the stitched wall), the
 roofline section (obs.attr cost-model buckets vs measured device/comm
 seconds and MFU — where the non-MFU time goes), MFU and throughput trend
-(first/middle/last thirds), the epoch table, cross-host skew/straggler
+(first/middle/last thirds), the epoch table, the decode/serving section
+(per-request latency p50/p99 + tok/s over `decode` events),
+cross-host skew/straggler
 summary, numerical-health trips (obs.health), flight-recorder diagnosis
 bundles (obs.flightrec), and any watchdog stall dumps; multi-process runs
-get a pointer at the merged Chrome trace (tools/trace_merge.py). ``--json``
+get a pointer at the merged Chrome trace (tools/trace_merge.py).
+Restart-attempt sibling ledgers (``run.a1.jsonl``, ... — obs.goodput run
+lineage) are auto-discovered and stitched into one job timeline, with the
+between-attempt gaps charged as ``restart_gap`` badput (``--no-discover``
+reads only the given file). ``--json``
 prints the same summary as one JSON object (the stable input for
 dashboards and the ROADMAP auto-tuner). Corrupt/truncated trailing lines —
 crashed runs are exactly the ones inspected here — are skipped with a
@@ -146,6 +156,83 @@ def roofline(cost_models, hot, mfu_mean=None, out=print):
             "peak_is_nominal": nominal}
 
 
+def _pctl(xs, q):
+    """Nearest-rank percentile of a sorted list (stdlib-only)."""
+    if not xs:
+        return None
+    return xs[min(int(round(q / 100.0 * (len(xs) - 1))), len(xs) - 1)]
+
+
+GOODPUT_LABELS = {"startup": "startup/compile", "data_wait": "data wait",
+                  "dispatch": "dispatch", "eval": "eval",
+                  "ckpt": "checkpoint", "stall": "watchdog stall",
+                  "skipped": "health-skipped", "idle": "idle/drain",
+                  "restart_gap": "restart gap"}
+
+
+def goodput_section(records, out=print):
+    """The accounting section (obs.goodput): goodput + badput categories
+    over the (possibly multi-attempt) stitched wall-clock. Returns the
+    machine-readable dict (rides in --json)."""
+    from tpu_dist.obs.goodput import job_accounting, split_attempts
+
+    attempts = split_attempts(records)
+    gp = job_accounting(attempts)
+    if gp is None or not gp["wall_s"]:
+        return gp
+    slo_events = [r for r in records if r["event"] == "slo"]
+    gp["slo_breaches"] = len(slo_events)
+    n_att = len(gp["attempts"])
+    wall = gp["wall_s"]
+    out(f"\ngoodput ({n_att} attempt(s), stitched wall {wall:.1f}s):")
+    rows = [("goodput", gp["goodput_s"])] + [
+        (cat, gp["categories"].get(cat, 0.0))
+        for cat in GOODPUT_LABELS]
+    for cat, secs in rows:
+        if cat != "goodput" and not secs:
+            continue  # only non-zero badput rows earn a line
+        out(f"  {GOODPUT_LABELS.get(cat, cat):<16} {secs:9.3f}s  "
+            f"{secs / wall * 100:5.1f}%")
+    out(f"  goodput ratio {gp['ratio']:.3f} over {gp['opt_steps']} "
+        f"optimizer steps"
+        + (f"; OVERRUN {gp['overrun_s']:.3f}s double-attributed"
+           if gp["overrun_s"] else ""))
+    if n_att > 1:
+        for a in gp["attempts"]:
+            out(f"  attempt {a['attempt']}: {a['wall_s']:.1f}s wall, "
+                f"{a['goodput_s']:.1f}s goodput, status "
+                f"{a['status'] or 'MISSING run_end (killed?)'}"
+                + (f", restart gap {a['restart_gap_s']:.1f}s before it"
+                   if a["restart_gap_s"] else ""))
+    if slo_events:
+        last = slo_events[-1]
+        out(f"  SLO: {len(slo_events)} breach(es); last: "
+            f"{last.get('kind')} {last.get('value')} < floor "
+            f"{last.get('floor')} at step {last.get('step')}")
+    return gp
+
+
+def decode_section(records, out=print):
+    """The serving-SLO section: per-request latency percentiles and tok/s
+    over the `decode` events (engine.generate / tools/decode_bench)."""
+    decodes = [r for r in records if r["event"] == "decode"]
+    if not decodes:
+        return None
+    secs = sorted(r["seconds"] for r in decodes
+                  if r.get("seconds") is not None)
+    toks = sum(r.get("tokens") or 0 for r in decodes)
+    total_s = sum(secs)
+    p50, p99 = _pctl(secs, 50), _pctl(secs, 99)
+    d = {"requests": len(decodes), "tokens": toks,
+         "tokens_per_sec": round(toks / total_s, 1) if total_s else None,
+         "latency_s": {"p50": p50, "p99": p99}}
+    out(f"\ndecode: {d['requests']} request(s), {_si(toks, 'tok')}"
+        + (f", {d['tokens_per_sec']:,.0f} tok/s" if total_s else "")
+        + (f"; latency p50 {p50 * 1e3:.1f}ms / p99 {p99 * 1e3:.1f}ms"
+           if p50 is not None else ""))
+    return d
+
+
 def summarize(records, out=print):
     """Render the summary through ``out`` and return the machine-readable
     dict (--json prints it verbatim; the legacy count keys ride along)."""
@@ -187,6 +274,9 @@ def summarize(records, out=print):
     elif records:
         out("NO run_end record: the writer died mid-run (crash/SIGKILL) — "
             "the events below are everything that reached disk")
+
+    # wall-clock accounting (obs.goodput) — attempts stitched, gaps charged
+    summary["goodput"] = goodput_section(records, out=out)
 
     if steps:
         # warm records carry the XLA compile in dispatch_s; exclude them
@@ -253,6 +343,9 @@ def summarize(records, out=print):
         summary["last_eval"] = {k: last.get(k)
                                 for k in ("epoch", "loss", "ppl", "acc1")}
 
+    # serving-SLO view over decode events (generate / decode_bench)
+    summary["decode"] = decode_section(records, out=out)
+
     if skews:
         worst = max(skews, key=lambda r: r["spread_s"])
         hist = {}
@@ -309,10 +402,30 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="print the summary as one JSON object on stdout "
                     "(human render suppressed)")
+    ap.add_argument("--no-discover", action="store_true",
+                    help="read only the given file (no .aN restart-attempt "
+                    "sibling stitching)")
     args = ap.parse_args(argv)
+    # restart lineage (obs.goodput): stitch every attempt of the job so
+    # the goodput section sees crash->restart gaps; any attempt's path
+    # finds the whole family
+    if args.no_discover:
+        paths = [args.path]
+    else:
+        from tpu_dist.obs.goodput import discover_attempt_paths
+
+        paths = discover_attempt_paths(args.path) or [args.path]
+        if len(paths) > 1 and not args.json:
+            print(f"stitching {len(paths)} attempt ledgers: "
+                  f"{[os.path.basename(p) for p in paths]}")
     # strict=False: a crashed writer leaves a torn trailing line, and a
     # crashed run is exactly the one being inspected — warn, don't raise
-    records = read_ledger(args.path, strict=False)
+    records = []
+    for p in paths:
+        try:
+            records.extend(read_ledger(p, strict=False))
+        except OSError as e:
+            print(f"warning: skipping {p}: {e}", file=sys.stderr)
     if not records:
         print(f"{args.path}: empty ledger", file=sys.stderr)
         return 1

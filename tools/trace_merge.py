@@ -24,7 +24,13 @@ in ``chrome://tracing`` / https://ui.perfetto.dev:
   (the distributed-init barrier aligns the processes' run starts far
   tighter than wall clocks agree across hosts; the residual offset is
   visible in the ``skew`` counter track, which records the measured
-  cross-host spread in-band).
+  cross-host spread in-band);
+* **restart attempts** (``run.a1.jsonl``, ... — obs.goodput run lineage)
+  are auto-discovered like the ``.pN`` process siblings: each attempt
+  renders its own lane group, offset on the time axis by its real
+  distance from attempt 0's ``run_start``, with a ``restart gap`` slice
+  spanning the crash→restart dead time the goodput report charges as
+  badput.
 
 Corrupt or truncated trailing lines — the signature of a crashed writer —
 are skipped with a warning (``read_ledger(strict=False)``): crashed runs
@@ -153,24 +159,89 @@ def _process_events(records: list, pid: int) -> list:
 
 def merge_ledgers(paths: list) -> dict:
     """Paths -> the Chrome trace object ({"traceEvents": [...], ...})."""
+    return merge_job([(0, paths)])
+
+
+def _run_start_ts(records: list):
+    for r in records:
+        if r.get("event") == "run_start":
+            return r["ts"]
+    return records[0]["ts"] if records else None
+
+
+def merge_job(groups: list) -> dict:
+    """[(attempt_index, [lane paths]), ...] -> one Chrome trace. A single
+    group is the classic multi-process merge; multiple groups (restart
+    attempts, obs.goodput lineage) offset each attempt's lanes by its real
+    wall distance from attempt 0's run_start and draw the restart gap."""
     events: list = []
     lanes = 0
-    for i, p in enumerate(paths):
-        try:
-            records = read_ledger(p, strict=False)
-        except OSError as e:
-            print(f"warning: skipping {p}: {e}", file=sys.stderr)
+    multi = len(groups) > 1
+    job_t0 = None
+    prev_end = None
+    # read everything first: the per-attempt pid offset must clear the
+    # HIGHEST process index seen anywhere (a 128-process job's attempt 0
+    # must not share lane pids with attempt 1's low processes)
+    loaded = []
+    max_pid = 0
+    for att, paths in groups:
+        lane_records = []
+        for i, p in enumerate(paths):
+            try:
+                records = read_ledger(p, strict=False)
+            except OSError as e:
+                print(f"warning: skipping {p}: {e}", file=sys.stderr)
+                continue
+            if not records:
+                print(f"warning: {p}: no readable records", file=sys.stderr)
+                continue
+            pid = records[0].get("pid", i)
+            max_pid = max(max_pid, pid)
+            lane_records.append((pid, records))
+        loaded.append((att, lane_records))
+    pid_stride = max(100, max_pid + 1)
+    for att, lane_records in loaded:
+        pid_off = att * pid_stride if multi else 0
+        att_events: list = []
+        att_t0 = None
+        att_end = None
+        for pid, records in lane_records:
+            att_events.extend(_process_events(records, pid))
+            lanes += 1
+            ts0 = _run_start_ts(records)
+            if att_t0 is None:  # the group's first (p0) file anchors it
+                att_t0 = ts0
+            last = max(r.get("ts", 0.0) for r in records)
+            att_end = last if att_end is None else max(att_end, last)
+        if att_t0 is None:
             continue
-        if not records:
-            print(f"warning: {p}: no readable records", file=sys.stderr)
-            continue
-        pid = records[0].get("pid", i)
-        events.extend(_process_events(records, pid))
-        lanes += 1
+        if job_t0 is None:
+            job_t0 = att_t0
+        offset_us = max((att_t0 - job_t0) * 1e6, 0.0)
+        for e in att_events:
+            e["pid"] += pid_off
+            if "ts" in e:
+                e["ts"] += offset_us
+            if e.get("ph") == "M" and e.get("name") == "process_name" \
+                    and multi:
+                e["args"]["name"] = (f"attempt {att} · "
+                                     f"{e['args'].get('name', '')}")
+        events.extend(att_events)
+        if multi and prev_end is not None and att_t0 > prev_end:
+            gap = att_t0 - prev_end
+            events.append({"ph": "X", "name": "restart gap",
+                           "pid": pid_off, "tid": TID_PHASES,
+                           "ts": offset_us - gap * 1e6, "dur": gap * 1e6,
+                           "args": {"gap_s": round(gap, 3),
+                                    "attempt": att}})
+        prev_end = att_end
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"tool": "tpu_dist tools/trace_merge.py",
                           "processes": lanes,
-                          "clock": "per-process, zeroed at run_start"}}
+                          "attempts": len(groups),
+                          "clock": ("per-process, zeroed at attempt 0's "
+                                    "run_start" if multi else
+                                    "per-process, zeroed at run_start")}}
 
 
 def main(argv=None) -> int:
@@ -181,23 +252,41 @@ def main(argv=None) -> int:
     ap.add_argument("-o", "--out", default="",
                     help="output path (default: <first ledger>.trace.json)")
     ap.add_argument("--no-discover", action="store_true",
-                    help="merge only the paths given (no .pN glob)")
+                    help="merge only the paths given (no .pN process or "
+                    ".aN attempt glob)")
     args = ap.parse_args(argv)
     paths = list(args.paths)
-    if not args.no_discover:
-        for sib in discover_ledgers(paths[0])[1:]:
-            if sib not in paths:
-                paths.append(sib)
-    trace = merge_ledgers(paths)
+    if args.no_discover:
+        trace = merge_ledgers(paths)
+    else:
+        # restart lineage first (run.jsonl, run.a1.jsonl, ... — obs.
+        # goodput), then each attempt's .pN process siblings
+        from tpu_dist.obs.goodput import (attempt_ordinal,
+                                          discover_attempt_paths)
+
+        attempt_paths = discover_attempt_paths(paths[0]) or [paths[0]]
+        groups = []
+        for j, base in enumerate(attempt_paths):
+            lane_paths = discover_ledgers(base)
+            if j == 0:
+                for extra in paths[1:]:
+                    if extra not in lane_paths:
+                        lane_paths.append(extra)
+            # label by the filename's stamped ordinal, not list position:
+            # a lost intermediate attempt must not renumber the rest
+            groups.append((attempt_ordinal(base), lane_paths))
+        trace = merge_job(groups)
     if not trace["traceEvents"]:
         print("no records in any input ledger", file=sys.stderr)
         return 1
     out = args.out or (os.path.splitext(paths[0])[0] + ".trace.json")
     with open(out, "w") as f:
         json.dump(trace, f)
-    print(f"{out}: {trace['otherData']['processes']} process lane(s), "
-          f"{len(trace['traceEvents'])} events — load in chrome://tracing "
-          "or ui.perfetto.dev")
+    n_att = trace["otherData"].get("attempts", 1)
+    print(f"{out}: {trace['otherData']['processes']} process lane(s)"
+          + (f" across {n_att} attempts" if n_att > 1 else "")
+          + f", {len(trace['traceEvents'])} events — load in "
+          "chrome://tracing or ui.perfetto.dev")
     return 0
 
 

@@ -155,6 +155,25 @@ class TrainConfig:
     flightrec_trace_steps: int = 3     # jax.profiler window: step records
                                        # captured after a trigger (0 = no
                                        # trace in the bundle)
+    job_id: str = ""                   # run lineage (obs.goodput): stable
+                                       # id shared by every restart attempt
+                                       # of one logical job (default: the
+                                       # ledger filename stem)
+    attempt: int = 0                   # restart ordinal: 0 = first attempt
+                                       # (bare ledger_path), N>0 writes
+                                       # <path>.aN, -1 = auto (next free
+                                       # index from the files on disk)
+    goodput_every_s: float = 60.0      # periodic 'goodput' ledger-event
+                                       # cadence in run seconds (0 = only
+                                       # the final one at run_end)
+    slo_steps_per_min: float = 0.0     # progress-SLO floor on EMA
+                                       # optimizer steps/min (0 = off);
+                                       # a breach emits an 'slo' event,
+                                       # which auto-triggers the flight
+                                       # recorder via the ledger sink
+    slo_throughput: float = 0.0        # progress-SLO floor on EMA items/s
+                                       # (img/s here, tok/s in LMConfig;
+                                       # 0 = off)
 
     # -- synthetic-data knobs (TPU-only: zero-egress envs can't download datasets)
     synth_train_size: int = 50000
@@ -302,6 +321,18 @@ class LMConfig:
                                    # ledger_path or a temp dir)
     flightrec_trace_steps: int = 3 # profiler window after a trigger, in
                                    # step records (0 = no trace)
+    job_id: str = ""               # run lineage (obs.goodput): stable id
+                                   # across restart attempts of one job
+                                   # (default: ledger filename stem)
+    attempt: int = 0               # restart ordinal: 0 = bare ledger_path,
+                                   # N>0 writes <path>.aN, -1 = auto
+    goodput_every_s: float = 60.0  # periodic 'goodput' event cadence
+                                   # (0 = only the final one at run_end)
+    slo_steps_per_min: float = 0.0 # progress-SLO floor on EMA optimizer
+                                   # steps/min (0 = off; breach emits
+                                   # 'slo' -> flight-recorder bundle)
+    slo_throughput: float = 0.0    # progress-SLO floor on EMA tok/s
+                                   # (0 = off)
 
 
 def add_args(parser: argparse.ArgumentParser, defaults) -> None:

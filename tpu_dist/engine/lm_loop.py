@@ -897,6 +897,7 @@ class LMTrainer:
         """Exact held-out metrics in EVERY mode: (loss, ppl, acc).
         Sampler wrap-padding is masked per row; sums divide by the true
         token count (the image Trainer's C15 contract, for tokens)."""
+        t0_eval = time.time()  # exact eval badput for the goodput ledger
         idx, valid = self._epoch_indices(self.val_ds, False, epoch)
         if self._val_rows_dev is not None:
             win_sh = NamedSharding(self.mesh, P(None, "data"))
@@ -926,7 +927,8 @@ class LMTrainer:
         ppl = float(np.exp(min(loss, 30.0)))
         acc = sums["correct1"] / n
         self.obs.ledger.emit("eval", epoch=epoch, loss=loss, ppl=ppl,
-                             acc=acc, count=int(sums["count"]))
+                             acc=acc, count=int(sums["count"]),
+                             seconds=round(time.time() - t0_eval, 6))
         self.log(f" * val_loss {loss:.4f} ppl {ppl:.2f} acc {acc:.3f}")
         return loss, ppl, acc
 
@@ -1054,6 +1056,9 @@ class LMTrainer:
                 hbm_bytes=peak_hbm_bytes() or self._program_hbm or None,
                 batches=train_metrics.get("batches"))
             if cfg.checkpoint_dir:
+                t0_ck = time.time()  # sync-path save cost (async writes
+                # overlap the next epoch; the goodput ledger charges only
+                # what actually blocked the loop)
                 ckpt.save_checkpoint(
                     cfg.checkpoint_dir, self.state, epoch + 1, 0.0, "lm",
                     is_best, extra_meta={"best_ppl": self.best_ppl,
@@ -1061,7 +1066,8 @@ class LMTrainer:
                     async_write=True)
                 self.obs.ledger.emit(
                     "ckpt", epoch=epoch + 1, path=cfg.checkpoint_dir,
-                    is_best=is_best)
+                    is_best=is_best,
+                    seconds=round(time.time() - t0_ck, 6))
             # LR actually applied by the LAST update of this epoch (the
             # schedule is evaluated at the pre-increment step counter)
             # distlint: disable=DL002 -- epoch boundary: validate() just drained the device queue, one scalar fetch is free

@@ -718,6 +718,7 @@ class Trainer:
         happens ONCE after the loop so eval batches pipeline (async dispatch),
         unlike the reference's per-batch barrier+allreduce. With an
         HBM-resident val set the whole eval is ONE dispatch."""
+        t0_eval = time.time()  # exact eval badput for the goodput ledger
         if self._val_data_dev is not None:
             idx, valid = self._epoch_indices(self.val_ds, False, epoch)
             win_sh = NamedSharding(self.mesh, P(None, "data"))
@@ -745,7 +746,8 @@ class Trainer:
         acc1 = sums["correct1"] / n
         acc5 = sums["correct5"] / n
         self.obs.ledger.emit("eval", epoch=epoch, loss=sums["loss_sum"] / n,
-                             acc1=acc1, acc5=acc5, count=int(sums["count"]))
+                             acc1=acc1, acc5=acc5, count=int(sums["count"]),
+                             seconds=round(time.time() - t0_eval, 6))
         self.log(f" * Acc@1 {acc1 * 100:.3f} Acc@5 {acc5 * 100:.3f} "
                  f"Loss {sums['loss_sum'] / n:.4f}")
         return acc1
@@ -829,13 +831,15 @@ class Trainer:
                 hbm_bytes=peak_hbm_bytes() or self._program_hbm or None,
                 batches=train_metrics.get("batches"))
             # async: serialization + disk write overlap the next epoch (the
-            # device->host gather stays on the critical path by necessity)
+            # device->host gather stays on the critical path by necessity);
+            # the goodput ledger charges only the blocking share
+            t0_ck = time.time()
             ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, epoch + 1,
                                  self.best_acc1, cfg.arch, is_best,
                                  extra_meta=self._run_meta, async_write=True)
             self.obs.ledger.emit(
                 "ckpt", epoch=epoch + 1, path=cfg.checkpoint_dir,
-                is_best=is_best)
+                is_best=is_best, seconds=round(time.time() - t0_ck, 6))
             self.log(f"Epoch {epoch}: train_loss={train_metrics['loss']:.4f} "
                      f"val_acc1={acc1 * 100:.3f} best={self.best_acc1 * 100:.3f} "
                      f"({epoch_secs:.1f}s, train {train_ips:,.0f} img/s)")

@@ -36,6 +36,10 @@ from typing import Optional
 
 from tpu_dist.obs.attr import bucket_totals, cost_buckets, emit_cost_model
 from tpu_dist.obs.flightrec import FlightRecorder
+from tpu_dist.obs.goodput import (GoodputAccumulator, GoodputMonitor,
+                                  attempt_path, discover_attempt_paths,
+                                  job_accounting, next_attempt_index,
+                                  split_attempts)
 from tpu_dist.obs.health import HealthError, HealthSentry, validate_health
 from tpu_dist.obs.ledger import (EVENT_SCHEMA, EpochCsvSink, Ledger,
                                  ProgressSink, per_process_path, phase_totals,
@@ -46,13 +50,15 @@ from tpu_dist.obs.skew import SkewMonitor
 from tpu_dist.obs.trace import StepTracer, profile_session, step_annotation
 from tpu_dist.obs.watchdog import Watchdog
 
-__all__ = ["EVENT_SCHEMA", "EpochCsvSink", "FlightRecorder", "HealthError",
+__all__ = ["EVENT_SCHEMA", "EpochCsvSink", "FlightRecorder",
+           "GoodputAccumulator", "GoodputMonitor", "HealthError",
            "HealthSentry", "Ledger", "MetricsRegistry", "ProgressSink",
            "RunObs", "SkewMonitor", "StepTracer", "Watchdog",
-           "bucket_totals", "cost_buckets", "emit_cost_model",
-           "metrics_ledger_sink", "per_process_path", "phase_totals",
-           "profile_session", "read_ledger", "serve_metrics",
-           "step_annotation"]
+           "attempt_path", "bucket_totals", "cost_buckets",
+           "discover_attempt_paths", "emit_cost_model", "job_accounting",
+           "metrics_ledger_sink", "next_attempt_index", "per_process_path",
+           "phase_totals", "profile_session", "read_ledger",
+           "serve_metrics", "split_attempts", "step_annotation"]
 
 
 def effective_peak_tflops() -> tuple:
@@ -84,8 +90,25 @@ class RunObs:
         self.unit = unit
         pidx = jax.process_index()
         self.is_main = pidx == 0
+        # run lineage (obs.goodput): one logical job = N restart attempts,
+        # each writing its OWN ledger (run.jsonl, run.a1.jsonl, ... — the
+        # restart analog of the .pN multi-process story) so the attempt
+        # tools can stitch the timeline back with restart gaps visible.
+        # attempt=-1 auto-picks the next free index from files on disk.
+        base_path = getattr(cfg, "ledger_path", "") or ""
+        attempt = int(getattr(cfg, "attempt", 0) or 0)
+        if attempt < 0:
+            # probes THIS process's own prior files, so process 0 creating
+            # the bare ledger first never makes a later-starting peer of
+            # the same attempt self-assign the next index
+            attempt = (next_attempt_index(base_path, pidx)
+                       if base_path else 0)
+        self.attempt = attempt
+        self.job_id = (getattr(cfg, "job_id", "") or
+                       (os.path.splitext(os.path.basename(base_path))[0]
+                        if base_path else None))
         ledger_path = per_process_path(
-            getattr(cfg, "ledger_path", "") or "", pidx)
+            attempt_path(base_path, attempt), pidx)
         self.ledger = Ledger(ledger_path or None, process_index=pidx)
         if getattr(cfg, "log_csv", "") and self.is_main:
             # the legacy per-epoch CSV becomes a VIEW of the epoch event
@@ -130,6 +153,17 @@ class RunObs:
             profiler_busy=lambda: self.profiling,
             process_index=pidx)
         self.ledger.add_sink(self.flightrec.sink)
+        # goodput accounting + progress-SLO watch (obs.goodput): another
+        # ledger sink — periodic 'goodput' partitions and 'slo' breach
+        # events ride the same one-event-stream fan-out, so the metrics
+        # gauges and the flight recorder see them with no new plumbing
+        self.goodput = GoodputMonitor(
+            self.ledger,
+            every_s=getattr(cfg, "goodput_every_s", 60.0),
+            slo_steps_per_min=getattr(cfg, "slo_steps_per_min", 0.0),
+            slo_throughput=getattr(cfg, "slo_throughput", 0.0),
+            unit=unit)
+        self.ledger.add_sink(self.goodput.sink)
         self._prev_sigusr1 = None
         self.peak_tflops, self.peak_is_nominal = effective_peak_tflops()
         self._mesh_info = (
@@ -158,7 +192,9 @@ class RunObs:
             device_count=jax.device_count(),
             peak_tflops=self.peak_tflops,
             peak_is_nominal=self.peak_is_nominal,
-            jax_version=jax.__version__)
+            jax_version=jax.__version__,
+            job_id=self.job_id, attempt=self.attempt,
+            resumed_from=getattr(self.cfg, "resume", "") or None)
         self._arm_crash_guard()
 
     def run_end(self, status: Optional[str] = None, **extra) -> None:
@@ -193,6 +229,14 @@ class RunObs:
                                                exc.__traceback__))[-2000:])
             else:
                 status = "ok"
+        # the final goodput partition (obs.goodput): always one 'goodput'
+        # event per attempt, however short the run — the attempt tools and
+        # the metrics snapshot below both read it. Exception-guarded: the
+        # crash paths (atexit/SIGTERM) reach here too
+        try:
+            self.goodput.emit_goodput(final=True)
+        except Exception:
+            pass
         # the registry's final values survive in the flight record after
         # the scrape endpoint is gone
         self.ledger.emit("metrics_snapshot", metrics=self.metrics.snapshot())

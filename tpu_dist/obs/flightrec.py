@@ -22,7 +22,8 @@ bundle directory:
   daemon-thread trigger must never touch it directly).
 
 Triggers: watchdog ``stall`` events, health-sentry ``health`` trips, skew
-samples whose spread marks a straggler spike, ``SIGUSR1`` (operator-
+samples whose spread marks a straggler spike, progress-SLO ``slo``
+breaches (obs.goodput), ``SIGUSR1`` (operator-
 initiated, armed by :class:`~tpu_dist.obs.RunObs`), or a direct
 :meth:`FlightRecorder.trigger` call. All but the signal arrive through the
 run ledger's event stream — the recorder is a ledger sink, the same
@@ -122,6 +123,13 @@ class FlightRecorder:
         elif ev == "skew" and _skew_is_spike(rec):
             self.trigger("skew", note=f"spread {rec.get('spread_s')}s, "
                                       f"straggler {rec.get('straggler')}")
+        elif ev == "slo":
+            # progress-SLO breach (obs.goodput): the run is alive but not
+            # making floor-rate progress — exactly a flight-record moment
+            self.trigger("slo", note=f"{rec.get('kind')} "
+                                     f"{rec.get('value')} < floor "
+                                     f"{rec.get('floor')} at step "
+                                     f"{rec.get('step')}")
 
     # -- capture ----------------------------------------------------------
     def _base_dir(self) -> str:

@@ -82,6 +82,15 @@ EVENT_SCHEMA = {
     # final registry dump (obs.metrics) so counter values survive in the
     # flight record after the scrape endpoint is gone
     "metrics_snapshot": ("metrics",),
+    # goodput/badput partition snapshot (obs.goodput): categories maps
+    # badput category -> seconds (startup/data_wait/dispatch/eval/ckpt/
+    # stall/skipped/idle[/restart_gap]); emitted periodically by the
+    # GoodputMonitor sink and once at run_end (final=True extra)
+    "goodput": ("wall_s", "goodput_s", "ratio", "categories"),
+    # progress-SLO breach (obs.goodput): EMA steps/min or items/s fell
+    # below the configured floor; auto-triggers the flight recorder
+    # through the ledger-sink path like every other detector event
+    "slo": ("step", "kind", "value", "floor"),
     # run rollup: total steps, wall seconds, best metric in extras;
     # status ("ok"|"crashed"|"interrupted") rides as an extra stamped by
     # RunObs.run_end — the crash-safe shutdown path sets "crashed"

@@ -131,9 +131,33 @@ class Counter(_Metric):
 
 
 class _GaugeChild(_CounterChild):
+    def __init__(self):
+        super().__init__()
+        self._fn = None
+
     def set(self, v: float) -> None:
         with self._lock:
             self._v = float(v)
+
+    def set_fn(self, fn) -> None:
+        """Computed gauge: ``fn()`` is evaluated at every read (render/
+        snapshot) — for values that age between scrapes, like
+        ``tpu_dist_last_step_age_s``. ``fn`` must be cheap and safe."""
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        fn = self._fn
+        if fn is not None:
+            try:
+                return float(fn())
+            except Exception:
+                return self._v
+        return self._v
+
+    def value_view(self):
+        return self.value
 
 
 class Gauge(_Metric):
@@ -144,6 +168,9 @@ class Gauge(_Metric):
 
     def set(self, v: float) -> None:
         self._default().set(v)
+
+    def set_fn(self, fn) -> None:
+        self._default().set_fn(fn)
 
     def inc(self, amount: float = 1.0) -> None:
         self._default().inc(amount)
@@ -242,6 +269,18 @@ class MetricsRegistry:
             metrics = list(self._metrics.items())
         return {name: m.snapshot() for name, m in metrics}
 
+    def read_value(self, name: str):
+        """The unlabeled child's current value, or None when the family
+        (or its default child) does not exist — a cheap single-series
+        read that never renders the registry (the /healthz path)."""
+        with self._lock:
+            m = self._metrics.get(name)
+        if m is None:
+            return None
+        with m._lock:
+            child = m._series.get(())
+        return None if child is None else child.value
+
 
 # -- the ledger -> registry bridge ----------------------------------------
 
@@ -275,6 +314,34 @@ def metrics_ledger_sink(reg: MetricsRegistry):
                          "numerical-health trips by kind")
     health.labels(kind="nonfinite")       # pre-register: scrape shows 0
     health.labels(kind="loss_spike")
+    # goodput accounting + progress SLOs (obs.goodput): the ratio and the
+    # per-category badput seconds track the last 'goodput' event (a
+    # snapshot partition, hence gauges); breaches are a counter by kind
+    goodput_ratio = reg.gauge("tpu_dist_goodput_ratio",
+                              "goodput share of wall-clock (0-1), from "
+                              "the last goodput event")
+    badput = reg.gauge("tpu_dist_badput_seconds",
+                       "badput seconds by category, from the last "
+                       "goodput event")
+    from tpu_dist.obs.goodput import CATEGORIES
+    for c in CATEGORIES:
+        badput.labels(category=c)         # pre-register: scrape shows 0
+    slo_breaches = reg.counter("tpu_dist_slo_breaches_total",
+                               "progress-SLO breaches by kind")
+    slo_breaches.labels(kind="steps_per_min")
+    slo_breaches.labels(kind="throughput")
+    # progress-aware liveness: seconds since the last step record,
+    # computed at read time (-1 before the first step) — the /healthz
+    # body carries it so an external probe can detect a stalled-but-alive
+    # run without parsing the full scrape
+    import time as _time
+
+    last_step_ts = [None]
+    age = reg.gauge("tpu_dist_last_step_age_s",
+                    "seconds since the last step record (-1 before any)")
+    age.labels().set_fn(
+        lambda: (round(_time.time() - last_step_ts[0], 3)
+                 if last_step_ts[0] else -1.0))
     # build_info-style identity gauge (value always 1; the labels are the
     # payload): scrapes from different runs/configs become joinable on
     # run_id/config_hash, Prometheus-standard style. The family is
@@ -292,7 +359,8 @@ def metrics_ledger_sink(reg: MetricsRegistry):
     # renders no sample line, and "0" vs "absent" are different answers
     # to "is it hung?"
     for m in (steps, items, mfu, loss, stalls, stall_idle, skew_spread,
-              straggler, epoch_g, eval_loss, hbm, decode_toks, step_hist):
+              straggler, epoch_g, eval_loss, hbm, decode_toks, step_hist,
+              goodput_ratio):
         m.labels()
 
     def sink(rec: dict) -> None:
@@ -312,6 +380,7 @@ def metrics_ledger_sink(reg: MetricsRegistry):
                 quant=str(cfg.get("quant") or "none"),
                 tp_impl=str(cfg.get("tp_impl") or "gspmd")).set(1)
         elif ev == "step":
+            last_step_ts[0] = rec.get("ts") or _time.time()
             n = rec.get("steps_in_dispatch") or 1
             steps.inc(n)
             if rec.get("items"):
@@ -356,6 +425,14 @@ def metrics_ledger_sink(reg: MetricsRegistry):
         elif ev == "decode":
             if rec.get("tokens"):
                 decode_toks.inc(rec["tokens"])
+        elif ev == "goodput":
+            if rec.get("ratio") is not None:
+                goodput_ratio.set(rec["ratio"])
+            for c, secs in (rec.get("categories") or {}).items():
+                if secs is not None:
+                    badput.labels(category=c).set(secs)
+        elif ev == "slo":
+            slo_breaches.labels(kind=rec.get("kind") or "unknown").inc()
 
     return sink
 
@@ -373,11 +450,20 @@ class MetricsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API name)
-                if self.path.split("?")[0] in ("/healthz", "/livez"):
+                path = self.path.split("?")[0]
+                if path in ("/healthz", "/livez"):
                     # trivial liveness: the process (and this daemon
                     # thread) is up — no registry render, so a wedged
-                    # metrics pipeline can't fail the liveness probe
+                    # metrics pipeline can't fail the liveness probe.
+                    # /healthz is additionally progress-aware: it carries
+                    # seconds since the last step record (one cheap
+                    # single-gauge read), so an external probe detects a
+                    # stalled-but-alive run without parsing the scrape
                     body = b"ok\n"
+                    if path == "/healthz":
+                        v = reg.read_value("tpu_dist_last_step_age_s")
+                        if isinstance(v, (int, float)):
+                            body = f"ok last_step_age_s={v:.3f}\n".encode()
                     ctype = "text/plain; charset=utf-8"
                 else:
                     body = reg.render().encode()
