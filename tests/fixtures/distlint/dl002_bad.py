@@ -1,12 +1,19 @@
-"""DL002 positive fixture: blocking host syncs inside a hot step loop."""
+"""DL002 positive fixture: blocking host syncs inside a hot step loop.
+
+``train_step`` is a real jit product, so the loop is hot with GRAPH
+EVIDENCE (tier 2) and the full blocking set applies — including the
+implicit-sync heuristics (np.asarray on a device value).
+"""
 
 import jax
 import numpy as np
 
+train_step = jax.jit(lambda s, i, l: (s, {"loss_sum": i, "count": l}))
 
-def train_epoch(loader, step_fn, state):
+
+def train_epoch(loader, state):
     for images, labels in loader:
-        state, metrics = step_fn(state, images, labels)
+        state, metrics = train_step(state, images, labels)
         loss_sum = np.asarray(metrics["loss_sum"])     # implicit device_get
         host = jax.device_get(metrics)                 # explicit sync
         count = host["count"].item()                   # .item() sync

@@ -1,0 +1,19 @@
+"""DL002 closure-seam fixture (the old false negative): a sync inside a
+nested def called from the hot loop used to escape the lexical scan
+because nested defs were excluded wholesale; the reachability pass makes
+it decidable."""
+
+import jax
+
+step = jax.jit(lambda s, b: s)
+
+
+def train_epoch(batches, state):
+    def log(metrics):
+        return metrics["loss"].item()     # runs every iteration: finding
+
+    losses = []
+    for b in batches:
+        state, m = step(state, b)
+        losses.append(log(m))
+    return state, losses

@@ -1,0 +1,14 @@
+"""DL002 closure-seam negative: queue in the loop, drain after it."""
+
+import jax
+
+step = jax.jit(lambda s, b: s)
+
+
+def train_epoch(batches, state):
+    pending = []
+    for b in batches:
+        state, m = step(state, b)
+        pending.append(m)                 # queue only: no per-step sync
+    fetched = jax.device_get(pending)     # one drain after the loop
+    return state, [m["loss"] for m in fetched]

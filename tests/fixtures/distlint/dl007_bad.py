@@ -1,0 +1,17 @@
+"""DL007 positive fixture: donated buffers referenced after the call."""
+
+import jax
+
+step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+
+def train(state, batch):
+    new_state = step(state, batch)
+    return state.step, new_state       # donated 'state' read again: finding
+
+
+def accumulate(state, batches):
+    outs = []
+    for b in batches:
+        outs.append(step(state, b))    # donates 'state' once...
+    return outs, state                 # ...then reads it: finding

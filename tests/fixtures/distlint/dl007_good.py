@@ -1,0 +1,16 @@
+"""DL007 negative fixture: rebinding (or not donating) is safe."""
+
+import jax
+
+step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+
+def train(state, batch):
+    state = step(state, batch)         # rebind: the dead buffer is gone
+    return state.step
+
+
+def undonated(state, batch):
+    f = jax.jit(lambda s, b: s)
+    out = f(state, batch)
+    return state, out                  # no donation: free to read

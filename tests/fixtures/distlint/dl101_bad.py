@@ -1,0 +1,38 @@
+"""DL101 positive fixture: the PR-5 Ledger SIGTERM deadlock shape.
+
+A plain (non-reentrant) threading.Lock guards both the main-thread emit
+site and a method the SIGTERM handler reaches: a signal landing while the
+main thread is inside emit() self-deadlocks in finalize().
+"""
+
+import signal
+import threading
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()      # non-reentrant: the bug
+        self._rows = []
+        self._prev = signal.signal(signal.SIGTERM, self._on_sigterm)
+
+    def emit(self, row):                   # main-thread emit site
+        with self._lock:
+            self._rows.append(row)
+
+    def finalize(self):
+        with self._lock:                   # handler-reachable acquire
+            self._rows.append("end")
+
+    def _on_sigterm(self, signum, frame):
+        self.finalize()
+        if callable(self._prev):
+            self._prev(signum, frame)
+
+
+def main():
+    rec = Recorder()
+    rec.emit("step")
+
+
+if __name__ == "__main__":
+    main()

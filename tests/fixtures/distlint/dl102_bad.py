@@ -1,0 +1,23 @@
+"""DL102 positive fixture: blocking I/O while holding the sink lock."""
+
+import threading
+import time
+import urllib.request
+
+
+class PushSink:
+    def __init__(self, url):
+        self._lock = threading.Lock()
+        self._buf = []
+        self._url = url
+
+    def sink(self, rec):                # the emit fan-out half
+        with self._lock:
+            self._buf.append(rec)
+
+    def push(self):
+        with self._lock:
+            for rec in self._buf:       # HTTP under the shared lock: finding
+                urllib.request.urlopen(self._url, data=rec)
+            time.sleep(0.1)             # sleep under the lock: finding
+            self._buf.clear()

@@ -1,0 +1,22 @@
+"""DL102 negative fixture: snapshot under the lock, I/O outside it."""
+
+import threading
+import urllib.request
+
+
+class PushSink:
+    def __init__(self, url):
+        self._lock = threading.Lock()
+        self._buf = []
+        self._url = url
+
+    def sink(self, rec):
+        with self._lock:
+            self._buf.append(rec)
+
+    def push(self):
+        with self._lock:                # only the cheap snapshot inside
+            rows = list(self._buf)
+            self._buf.clear()
+        for rec in rows:                # the slow half runs lock-free
+            urllib.request.urlopen(self._url, data=rec)

@@ -1,0 +1,23 @@
+"""DL103 positive fixture: non-daemon threads nobody ever joins."""
+
+import threading
+
+
+def start_worker(q):
+    t = threading.Thread(target=_pump, args=(q,))    # no daemon, no join
+    t.start()
+    return t
+
+
+def _pump(q):
+    while True:
+        q.get()
+
+
+class Sampler:
+    def start(self):
+        self._thread = threading.Thread(target=self._run)   # same hazard
+        self._thread.start()
+
+    def _run(self):
+        pass

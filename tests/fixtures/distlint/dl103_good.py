@@ -1,0 +1,26 @@
+"""DL103 negative fixture: daemon helpers, or a join on shutdown."""
+
+import threading
+
+
+def start_worker(q):
+    t = threading.Thread(target=_pump, args=(q,), daemon=True)
+    t.start()
+    return t
+
+
+def _pump(q):
+    while True:
+        q.get()
+
+
+class Sampler:
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def close(self):                     # the shutdown-path join
+        self._thread.join(timeout=1.0)

@@ -1,0 +1,15 @@
+"""DL104 positive fixture: io in the handler body, dropped prior hook."""
+
+import logging
+import signal
+import sys
+
+
+def _on_term(signum, frame):
+    logging.error("terminating")       # logging is not reentrant: finding
+    sys.stderr.flush()                 # flush chain in a handler: finding
+    raise SystemExit(1)
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)   # prior handler dropped: finding

@@ -1,0 +1,17 @@
+"""DL104 negative fixture: flag-setting handler that chains its prior."""
+
+import signal
+
+_flag = {"term": False}
+_PREV = {}
+
+
+def _on_term(signum, frame):
+    _flag["term"] = True               # just a flag; no io in the handler
+    prev = _PREV.get("h")
+    if callable(prev):
+        prev(signum, frame)
+
+
+def install():
+    _PREV["h"] = signal.signal(signal.SIGTERM, _on_term)   # captured+chained

@@ -37,6 +37,7 @@ def setup():
 
 
 def _loss(m):
+    # distlint: disable=DL002 -- test helper: drains one metrics tree for assertions
     m = jax.device_get(m)
     return float(m["loss_sum"]) / float(m["count"])
 

@@ -174,6 +174,7 @@ def test_pp_multiple_steps_converge():
     losses = []
     for _ in range(8):
         st, m = step(st, di, dt, key)
+        # distlint: disable=DL002 -- CPU test: per-step loss assertion needs the value now
         losses.append(float(jax.device_get(m["loss_sum"]))
                       / float(jax.device_get(m["count"])))
     assert losses[-1] < losses[0] * 0.85, losses

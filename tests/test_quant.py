@@ -160,6 +160,7 @@ def _train(lm, params, mesh, steps=60, lr=0.05):
     m = None
     for _ in range(steps):
         state, m = step(state, di, dt, key)
+        # distlint: disable=DL002 -- bounds the async queue on the CPU sim (trailing comment)
         jax.block_until_ready(state.step)  # bound the async queue (CPU sim)
     m = jax.device_get(m)
     return state, float(m["loss_sum"]) / float(m["count"])

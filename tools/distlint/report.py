@@ -1,0 +1,181 @@
+"""CI-grade output formats: SARIF 2.1.0 and the suppression-debt report.
+
+SARIF (Static Analysis Results Interchange Format) is what CI code-scanning
+surfaces ingest natively; emitting it makes distlint findings first-class
+review annotations instead of a log to grep. The debt report is the other
+half of the suppression contract: every ``# distlint: disable`` carries a
+reason, and ``--debt`` inventories them (per-rule counts, locations, file
+age, staleness) so a handful of reasoned pins never silently grows into a
+pile nobody audits.
+
+Stdlib-only like the rest of the package; ``git`` is invoked for file ages
+when available and skipped silently when not (CI tarballs, no-git trees).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tools.distlint.core import (META_RULE, LintResult, iter_python_files,
+                                 parse_suppressions)
+from tools.distlint.rules import RULES, RULES_BY_ID
+
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+SARIF_VERSION = "2.1.0"
+
+# SARIF 'level' per severity tier ('warn' is called 'warning' there)
+_SARIF_LEVEL = {"error": "error", "warn": "warning"}
+
+
+def severity_of(rule_id: str) -> str:
+    """'error' | 'warn' for a rule id (DL000 meta findings are errors:
+    a malformed suppression or unparseable file must gate)."""
+    if rule_id == META_RULE:
+        return "error"
+    r = RULES_BY_ID.get(rule_id)
+    return getattr(r, "severity", "error") if r is not None else "error"
+
+
+def split_by_severity(result: LintResult) -> Tuple[list, list]:
+    """(error_findings, warn_findings)."""
+    err = [f for f in result.findings if severity_of(f.rule) == "error"]
+    warn = [f for f in result.findings if severity_of(f.rule) == "warn"]
+    return err, warn
+
+
+def to_sarif(result: LintResult) -> dict:
+    """Minimal valid SARIF 2.1.0 log: one run, the full rule catalog as
+    tool metadata, one result per finding (1-based columns, per spec)."""
+    rules_meta = [{
+        "id": META_RULE,
+        "shortDescription": {"text": "malformed suppression / "
+                                     "unparseable file"},
+        "defaultConfiguration": {"level": "error"},
+    }]
+    for r in RULES:
+        rules_meta.append({
+            "id": r.id,
+            "shortDescription": {"text": r.title},
+            "fullDescription": {"text": r.rationale},
+            "defaultConfiguration": {
+                "level": _SARIF_LEVEL[getattr(r, "severity", "error")]},
+        })
+    results = []
+    for f in result.findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL[severity_of(f.rule)],
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                # informationUri is typed as an ABSOLUTE uri in the SARIF
+                # schema; a relative README anchor would make strict
+                # consumers reject the whole artifact, so it is omitted
+                "name": "distlint",
+                "rules": rules_meta,
+            }},
+            # SRCROOT is deliberately left undeclared (no
+            # originalUriBaseIds): consumers resolve the repo-relative
+            # URIs against their own checkout, GitHub-code-scanning
+            # style; declaring file:/// would point at filesystem root
+            "results": results,
+        }],
+    }
+
+
+# ------------------------------------------------------------------ debt
+def _git_file_age_days(root: str, rel: str) -> Optional[float]:
+    """Days since the last commit touching ``rel`` (None when git is
+    absent, the tree is not a repo, or the file is uncommitted)."""
+    try:
+        out = subprocess.run(
+            ["git", "log", "-1", "--format=%ct", "--", rel],
+            cwd=root, capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    ts = out.stdout.strip()
+    if out.returncode != 0 or not ts:
+        return None
+    try:
+        return max(0.0, (time.time() - int(ts)) / 86400.0)
+    except ValueError:
+        return None
+
+
+def collect_debt(paths, root: str, result: Optional[LintResult] = None,
+                 with_ages: bool = True) -> dict:
+    """Inventory every suppression comment under ``paths``.
+
+    Returns ``{"entries": [...], "by_rule": {rule: count},
+    "stale": [...]}``. When a ``result`` from the same surface is given,
+    suppressions that matched no finding are listed as stale — a stale
+    pin is a rule the tree no longer violates, i.e. deletable debt.
+    ``with_ages=False`` skips the per-file ``git log`` subprocesses
+    (tests that only assert counts/staleness stay cheap)."""
+    active: set = set()
+    if result is not None:
+        active = {(f.path, s.comment_line) for f, s in result.suppressed}
+    entries: List[dict] = []
+    by_rule: Dict[str, int] = {}
+    age_cache: Dict[str, Optional[float]] = {}
+    for path in iter_python_files(paths, root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        sups, _ = parse_suppressions(src)
+        if with_ages and sups and rel not in age_cache:
+            age_cache[rel] = _git_file_age_days(root, rel)
+        for s in sups:
+            for rule in s.rules:
+                by_rule[rule] = by_rule.get(rule, 0) + 1
+            entries.append({
+                "path": rel, "line": s.comment_line,
+                "rules": list(s.rules), "reason": s.reason,
+                "file_age_days": age_cache.get(rel),
+                "stale": (result is not None
+                          and (rel, s.comment_line) not in active),
+            })
+    entries.sort(key=lambda e: (e["path"], e["line"]))
+    return {"entries": entries, "by_rule": dict(sorted(by_rule.items())),
+            "stale": [e for e in entries if e["stale"]]}
+
+
+def render_debt(debt: dict) -> str:
+    """Human rendering of :func:`collect_debt` (the advisory print
+    scripts/lint.sh tacks onto the gate)."""
+    entries = debt["entries"]
+    lines = [f"distlint debt: {len(entries)} suppression(s)"]
+    if not entries:
+        return lines[0]
+    counts = "  ".join(f"{r} x{n}" for r, n in debt["by_rule"].items())
+    lines.append(f"  per rule: {counts}")
+    for e in entries:
+        age = (f"{e['file_age_days']:.0f}d" if e["file_age_days"]
+               is not None else "?")
+        mark = "  [STALE: matched no finding]" if e["stale"] else ""
+        lines.append(f"  {e['path']}:{e['line']}  "
+                     f"{','.join(e['rules'])}  (file age {age})  "
+                     f"-- {e['reason']}{mark}")
+    n_stale = len(debt["stale"])
+    if n_stale:
+        lines.append(f"  {n_stale} stale suppression(s) above can likely "
+                     "be deleted")
+    return "\n".join(lines)

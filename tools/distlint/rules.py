@@ -20,6 +20,26 @@ DL005  PRNG hygiene: a key consumed twice (correlated draws), and global
        numpy/stdlib RNG state (per-process divergence, irreproducibility).
 DL006  every ``*ledger*.emit(...)`` call site conforms to EVENT_SCHEMA
        (the absorbed tools/check_ledger_schema check).
+DL007  buffers donated to a jitted call (``donate_argnums``) referenced
+       afterwards — the device buffer may already be reused by XLA.
+
+The DL1xx family rides the cross-file call graph + reachability pass
+(core.CallGraph): concurrency and signal-safety hazards in the threaded
+obs layer, the failure class PR 5's Ledger SIGTERM deadlock proved real:
+
+DL101  non-reentrant ``threading.Lock`` acquired on a path reachable from
+       a signal handler while the same lock guards main-thread emit
+       sites (the exact PR-5 self-deadlock; the shipped RLock is clean).
+DL102  blocking I/O (subprocess/socket/HTTP/sleep) while holding a lock
+       the hot-path emit fan-out also takes.  [warn tier]
+DL103  ``threading.Thread`` without ``daemon=True`` and without a join on
+       the shutdown path — a crashed run that never exits.  [warn tier]
+DL104  signal handlers calling non-reentrant stdlib (logging, io flush
+       chains), and ``signal.signal`` installs that drop the previously
+       installed handler instead of chaining it.
+
+Severity tiers: every rule carries ``severity`` ('error' gates CI via
+scripts/lint.sh; 'warn' reports without failing the build).
 """
 
 from __future__ import annotations
@@ -29,13 +49,22 @@ import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from tools.distlint.core import (FileContext, Finding, Project, dotted_name,
-                                 terminal_name)
+                                 graph_scope, terminal_name)
 
 
 class Rule:
     id = "DL999"
     title = ""
     rationale = ""
+    # severity tier: 'error' findings gate CI (scripts/lint.sh exits
+    # non-zero); 'warn' findings report but do not fail the build — the
+    # tier for heuristic-leaning rules whose false-positive cost is real
+    severity = "error"
+    # graph-backed rules open graph_scope; lint_files hoists ONE
+    # ensure/remove of the file per lint pass when any is selected, so
+    # five rules don't re-index (and re-invalidate the reachability
+    # memos of) an out-of-surface file five times
+    uses_graph = False
 
     def check(self, ctx: FileContext, project: Project) -> List[Finding]:
         raise NotImplementedError
@@ -43,6 +72,16 @@ class Rule:
     def finding(self, ctx: FileContext, node: ast.AST, message: str) -> Finding:
         return Finding(self.id, ctx.rel, getattr(node, "lineno", 0),
                        getattr(node, "col_offset", 0), message)
+
+
+def _assign_parts(stmt: ast.AST) -> Tuple[Optional[ast.AST],
+                                          Optional[ast.AST]]:
+    """(target, value) for plain and annotated single-target assigns."""
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        return stmt.targets[0], stmt.value
+    if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        return stmt.target, stmt.value
+    return None, None
 
 
 def _calls(node: ast.AST) -> Iterable[ast.Call]:
@@ -85,14 +124,23 @@ class HostDivergentCollectives(Rule):
     # save_checkpoint's sharded gather, conditionally inside)
     COLLECTIVES = {
         "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
-        "ppermute", "pshuffle", "axis_index",
+        "ppermute", "pshuffle", "axis_index", "psum_scatter",
         "process_allgather", "sync_global_devices", "broadcast_one_to_all",
         "assemble_global", "make_array_from_process_local_data",
         "save_checkpoint", "barrier", "allreduce", "adasum_reduce",
+        # the ring/decode collectives (parallel/overlap.py,
+        # parallel/collectives.py): ppermute/psum_scatter chains under the
+        # hood, so a host-divergent guard around them deadlocks identically
+        "ring_allreduce", "ring_allgather_matmul",
+        "ring_matmul_reduce_scatter", "bucketed_grad_sync", "reduce_mean",
     }
     _DIVERGENT_NAMES = {"is_main", "is_master", "is_primary", "main_process"}
+    _GATE_RE = re.compile(r"process_index|is_main|is_master|is_primary|"
+                          r"main_process|rank")
 
     def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        if not self._GATE_RE.search(ctx.src):
+            return []   # no divergence vocabulary: no guard to flag
         out: List[Finding] = []
         self._scan(ctx.tree.body, False, ctx, out)
         return out
@@ -172,76 +220,151 @@ class HostDivergentCollectives(Rule):
 
 # ------------------------------------------------------------------ DL002
 class HotLoopHostSync(Rule):
+    uses_graph = True
     id = "DL002"
-    title = "blocking host sync in a hot step loop"
+    title = "blocking host sync on the hot step path"
     rationale = ("each .item()/device_get/np.asarray inside the step loop "
                  "drains the async-dispatch queue, serializing host and "
                  "device — the exact failure the drain-boundary design "
                  "avoids")
 
-    # functions whose loops are the engines' hot paths (the decode tick is
-    # a lax.scan INSIDE jit — DL004's domain — so generate.py carries no
-    # Python-level hot loop to list here)
-    HOT_FUNC_RE = re.compile(
-        r"^(train_epoch|_train_epoch_windowed|_fit_epochs|validate)$")
+    # What counts as hot is DERIVED, not listed: a loop is a step loop
+    # when its body (transitively, through the call graph) dispatches a
+    # jit/shard_map-traced computation — either a resolved traced handle
+    # (self.train_step = make_train_step(...) where the maker returns
+    # jax.jit(...)) or, as a syntactic backstop, a callee whose name says
+    # it dispatches steps. Everything REACHABLE from a step-loop body is
+    # hot too, which closes the old closure seam: a .item() inside a
+    # helper or nested def that the loop calls no longer escapes because
+    # the def's body sat outside the loop's lexical extent.
+    STEP_NAME_RE = re.compile(r"step|dispatch", re.I)
     BLOCKING_METHODS = {"item", "block_until_ready", "tolist"}
     BLOCKING_QUALS = {"jax.device_get", "device_get", "numpy.asarray",
                       "numpy.array", "jax.block_until_ready"}
+    # the reachable-body scan (helpers called FROM a hot loop) narrows
+    # only the QUALS: np.asarray/float(x) on host values is ordinary
+    # Python in a constructor or parser, and flagging it there would
+    # bury the real syncs in noise — lexically inside a step loop the
+    # odds flip, so the full qual set applies only there. The method
+    # set (.item()/.tolist()/.block_until_ready()) is unambiguous in
+    # either position and applies to both tiers.
+    STRICT_QUALS = {"jax.device_get", "device_get",
+                    "jax.block_until_ready"}
 
     def check(self, ctx: FileContext, project: Project) -> List[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(ctx.tree):
-            if (isinstance(node, ast.FunctionDef)
-                    and self.HOT_FUNC_RE.match(node.name)):
-                for loop in self._loops(node):
-                    for stmt in loop.body + loop.orelse:
-                        self._scan_stmt(stmt, node.name, ctx, out)
-        return out
+        with graph_scope(project, ctx) as g:
+            reaches = g.reaches_traced()
+            traced = g.traced_funcs()
+            hot = self._hot_funcs(g, reaches, traced)
+            for node in g.file_nodes(ctx.rel):
+                if node.qual in traced:
+                    continue
+                # lexical: statements inside this file's hot loop bodies —
+                # the `<module>` pseudo-node included (a top-level step
+                # loop in a script is as hot as one in a function; only
+                # the hot-BODY rescan below needs a real def node).
+                # Loops with GRAPH EVIDENCE of a traced dispatch get the
+                # full blocking set (float(x)/np.asarray included); loops
+                # hot only by callee NAME get the strict set — a drain
+                # loop iterating already-fetched host floats must not
+                # drown the report in int(host_value) noise
+                for loop in node.loops:
+                    how = self._loop_is_hot(node, loop, g, reaches, traced)
+                    if how:
+                        for stmt in loop.body + loop.orelse:
+                            self._scan_stmt(stmt, node.name, ctx, out,
+                                            strict=(how == 1),
+                                            lexical=True)
+                # reachability: whole body of functions called (directly
+                # or transitively) from ANY hot loop body in the project
+                if node.node is not None and node.qual in hot:
+                    for stmt in node.node.body:
+                        self._scan_stmt(stmt, node.name, ctx, out,
+                                        strict=True, lexical=False)
+        seen: set = set()
+        uniq: List[Finding] = []
+        for f in sorted(out, key=lambda f: (f.line, f.col)):
+            if (f.line, f.col) not in seen:
+                seen.add((f.line, f.col))
+                uniq.append(f)
+        return uniq
 
-    def _loops(self, fn: ast.FunctionDef):
-        """For/While nodes in fn, NOT descending into nested functions
-        (generators/closures run off the hot path — prefetch threads)."""
-        stack: List[ast.AST] = list(fn.body)
-        while stack:
-            n = stack.pop()
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.Lambda)):
-                continue
-            if isinstance(n, (ast.For, ast.While)):
-                yield n
-                continue  # inner loops are reached via the body scan
-            stack.extend(ast.iter_child_nodes(n))
+    def _loop_calls(self, node, loop) -> List[str]:
+        """Same-scope call heads whose call site sits inside ``loop``'s
+        body (the node's call list excludes nested-def bodies already)."""
+        end = getattr(loop, "end_lineno", loop.lineno)
+        return [h for h, line in node.calls if loop.lineno <= line <= end]
+
+    def _loop_is_hot(self, node, loop, g, reaches, traced) -> int:
+        """0 = not hot; 2 = hot with graph evidence (a body call resolves
+        to a traced computation); 1 = hot by callee name only."""
+        how = 0
+        for head in self._loop_calls(node, loop):
+            targets, is_traced = g.resolve(node, head)
+            if is_traced or any(t in reaches or t in traced
+                                for t in targets):
+                return 2
+            if self.STEP_NAME_RE.search(head.rpartition(".")[2]):
+                how = 1
+        return how
+
+    def _hot_funcs(self, g, reaches, traced) -> set:
+        """Functions reachable from any hot loop body in the graph (the
+        project surface plus the file under lint), minus traced bodies —
+        memoized on the graph version."""
+        def compute():
+            roots: List[str] = []
+            for node in g.funcs.values():
+                if node.qual in traced:
+                    continue   # module nodes seed too: top-level loops
+                for loop in node.loops:
+                    if self._loop_is_hot(node, loop, g, reaches, traced):
+                        for head in self._loop_calls(node, loop):
+                            targets, _ = g.resolve(node, head)
+                            roots.extend(t for t in targets
+                                         if t not in traced)
+            return g.reachable_from(roots) - traced
+        return g._memoized("dl002_hot", compute)
 
     def _scan_stmt(self, stmt: ast.stmt, fn_name: str, ctx: FileContext,
-                   out: List[Finding]) -> None:
+                   out: List[Finding], strict: bool = False,
+                   lexical: bool = True) -> None:
+        # `strict` narrows the blocking-qual set; `lexical` picks the
+        # message (inside this loop vs reachable from one) — independent
+        # axes: a name-only hot loop is strict AND lexical
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.Lambda)):
-            return  # off-loop execution (prefetch thread / deferred)
+            return  # separate node: the reachability pass covers it
         for child in ast.iter_child_nodes(stmt):
-            self._scan_stmt(child, fn_name, ctx, out)
+            self._scan_stmt(child, fn_name, ctx, out, strict, lexical)
         if isinstance(stmt, ast.Call):
             n = stmt
             bad = None
             tname = terminal_name(n.func)
             qual = ctx.resolve(dotted_name(n.func))
-            if isinstance(n.func, ast.Attribute) \
-                    and tname in self.BLOCKING_METHODS:
+            methods = self.BLOCKING_METHODS   # same set in both tiers
+            quals = self.STRICT_QUALS if strict else self.BLOCKING_QUALS
+            if isinstance(n.func, ast.Attribute) and tname in methods:
                 bad = f".{tname}()"
-            elif qual in self.BLOCKING_QUALS:
+            elif qual in quals:
                 bad = qual
-            elif (isinstance(n.func, ast.Name) and n.func.id in ("float", "int")
-                  and n.args
+            elif (not strict and isinstance(n.func, ast.Name)
+                  and n.func.id in ("float", "int") and n.args
                   and isinstance(n.args[0], (ast.Name, ast.Attribute))):
                 # float(x)/int(x) on a bare name is the classic implicit
                 # device->host sync; subscript/call args are usually reads
                 # of an already-fetched dict and stay silent
                 bad = f"{n.func.id}({dotted_name(n.args[0])})"
             if bad:
+                where = (f"inside the hot loop of {fn_name}()" if lexical
+                         else f"in {fn_name}(), reachable from a hot "
+                              f"step loop")
                 out.append(self.finding(
                     ctx, n,
-                    f"blocking host sync {bad!r} inside the hot loop of "
-                    f"{fn_name}() stalls async dispatch; queue device "
-                    "values and fetch them at a drain boundary instead"))
+                    f"blocking host sync {bad!r} {where} stalls async "
+                    "dispatch; queue device values and fetch them at a "
+                    "drain boundary instead"))
 
 
 # ------------------------------------------------------------------ DL003
@@ -254,11 +377,16 @@ class UnknownMeshAxis(Rule):
 
     SPEC_CTORS = {"P", "PartitionSpec"}
     AXIS_ARG_CALLS = {"psum", "pmean", "pmax", "pmin", "all_gather",
-                      "all_to_all", "ppermute", "axis_index", "pbroadcast"}
+                      "all_to_all", "ppermute", "axis_index", "pbroadcast",
+                      "psum_scatter"}
+
+    _GATE_RE = re.compile(r"PartitionSpec|P\(|psum|pmean|pmax|pmin|"
+                          r"all_gather|all_to_all|ppermute|axis_index|"
+                          r"pbroadcast")
 
     def check(self, ctx: FileContext, project: Project) -> List[Finding]:
         axes = project.mesh_axes
-        if not axes:
+        if not axes or not self._GATE_RE.search(ctx.src):
             return []
         out: List[Finding] = []
         for call in _calls(ctx.tree):
@@ -311,6 +439,8 @@ class TracedSideEffect(Rule):
     SIDE_EFFECT_NAMES = {"print", "input", "breakpoint"}
 
     def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        if "jit" not in ctx.src and "shard_map" not in ctx.src:
+            return []   # nothing traced here
         defs: Dict[str, List[ast.FunctionDef]] = {}
         for n in ast.walk(ctx.tree):
             if isinstance(n, ast.FunctionDef):
@@ -418,6 +548,8 @@ class PrngHygiene(Rule):
     STDLIB_SAFE = {"Random", "SystemRandom"}
 
     def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        if "random" not in ctx.src:
+            return []   # both halves of the rule need RNG vocabulary
         out: List[Finding] = []
         for n in ast.walk(ctx.tree):
             if isinstance(n, ast.Call):
@@ -601,14 +733,370 @@ class LedgerSchema(Rule):
                  "a ledger")
 
     def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        if ".emit(" not in ctx.src:
+            return []
         schema = project.event_schema
         if not schema:
             return []
         return check_emit_calls(ctx, schema, self.id)
 
 
+# ------------------------------------------------------------------ DL007
+class DonatedBufferReuse(Rule):
+    id = "DL007"
+    title = "donated buffer referenced after the jitted call"
+    rationale = ("donate_argnums hands the argument's device buffer to "
+                 "XLA for reuse; reading the Python reference afterwards "
+                 "returns garbage (or raises on deletion-checking "
+                 "backends) — rebind or stop donating")
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        if "donate_argnums" not in ctx.src:
+            return []   # cheap text gate before any AST walking
+        out: List[Finding] = []
+        # module-level jit handles (`step = jax.jit(f, donate_argnums=..)`)
+        # are visible to every function scope — collect them first
+        module_donating: Dict[str, Tuple[int, ...]] = {}
+        for stmt in ctx.tree.body:
+            tgt, val = _assign_parts(stmt)
+            if isinstance(tgt, ast.Name) and isinstance(val, ast.Call) \
+                    and terminal_name(val.func) in ("jit", "pjit"):
+                pos = self._donated_positions(val)
+                if pos:
+                    module_donating[tgt.id] = pos
+        scopes = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                               if isinstance(n, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef))]
+        for scope in scopes:
+            self._check_scope(scope, ctx, out, dict(module_donating))
+        return out
+
+    @staticmethod
+    def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+        for k in call.keywords:
+            if k.arg == "donate_argnums":
+                v = k.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    pos = tuple(e.value for e in v.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, int))
+                    return pos or None
+        return None
+
+    def _check_scope(self, scope, ctx: FileContext, out: List[Finding],
+                     donating: Optional[Dict[str, Tuple[int, ...]]] = None
+                     ) -> None:
+        body = scope.body if hasattr(scope, "body") else []
+        donating = dict(donating or {})
+        # ordering is by (line, col) against the call's END position —
+        # args on continuation lines of a multi-line call sit inside the
+        # span (not "after" it), and a same-line read past the closing
+        # paren (`return f(s), s.step`) is a real post-donation use
+        consumed: List[Tuple[str, int, Tuple[int, int], ast.AST]] = []
+        assigns: Dict[str, List[Tuple[int, int]]] = {}
+        reads: Dict[str, List[Tuple[Tuple[int, int], ast.AST]]] = {}
+
+        def walk(n: ast.AST) -> None:
+            for kid in ast.iter_child_nodes(n):
+                if isinstance(kid, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    continue   # nested scopes get their own pass
+                walk(kid)
+            tgt, val = _assign_parts(n)
+            if isinstance(tgt, ast.Name) and isinstance(val, ast.Call) \
+                    and terminal_name(val.func) in ("jit", "pjit"):
+                pos = self._donated_positions(val)
+                if pos:
+                    donating[tgt.id] = pos
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id in donating:
+                end = (n.end_lineno or n.lineno, n.end_col_offset or 0)
+                for p in donating[n.func.id]:
+                    if p < len(n.args) and isinstance(n.args[p], ast.Name):
+                        consumed.append((n.args[p].id, n.lineno, end, n))
+            if isinstance(n, ast.Name):
+                if isinstance(n.ctx, ast.Load):
+                    reads.setdefault(n.id, []).append(
+                        ((n.lineno, n.col_offset), n))
+                else:
+                    assigns.setdefault(n.id, []).append(
+                        (n.lineno, n.col_offset))
+
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            walk(stmt)
+        for var, call_line, call_end, _ in consumed:
+            rebinds = assigns.get(var, ())
+            for read_pos, node in sorted(reads.get(var, ()),
+                                         key=lambda r: r[0]):
+                if read_pos <= call_end:
+                    continue   # before the call, or one of its own args
+                if any((call_line, -1) <= a < read_pos for a in rebinds):
+                    continue   # state = step(state, ...) rebinding pattern
+                out.append(self.finding(
+                    ctx, node,
+                    f"'{var}' was donated to a jitted call on line "
+                    f"{call_line} (donate_argnums) and is read again here; "
+                    "its device buffer may already be reused — rebind the "
+                    "result or drop the donation"))
+                break   # one finding per (var, donation) pair is enough
+        return
+
+
+# ------------------------------------------------ DL101-DL104 concurrency
+class SignalLockDeadlock(Rule):
+    uses_graph = True
+    id = "DL101"
+    title = "plain Lock on a signal-handler path"
+    rationale = ("a signal handler runs ON the main thread between "
+                 "bytecodes; if it acquires a non-reentrant "
+                 "threading.Lock that the interrupted main-thread code "
+                 "was holding (the emit/sink fan-out), the process "
+                 "self-deadlocks — exactly the PR-5 Ledger SIGTERM bug. "
+                 "Use threading.RLock for any lock visible to a handler")
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        if "Lock(" not in ctx.src:
+            return []   # cheap text gate: no lock construction here
+        out: List[Finding] = []
+        with graph_scope(project, ctx) as g:
+            plain = {key: kind for key, kind in g.lock_attrs.items()
+                     if key[0][0] == ctx.rel and kind == "Lock"}
+            if not plain:
+                return out
+            hr = g.handler_reachable()
+            ml = g.mainline_reachable()
+            # acquire sites per (clskey, attr) in this file
+            sites: Dict[tuple, List[tuple]] = {}
+            for node in g.file_nodes(ctx.rel):
+                if node.cls is None:
+                    continue
+                for owner, attr, line, col in node.lock_acquires:
+                    if owner == "self" and (node.cls, attr) in plain:
+                        sites.setdefault((node.cls, attr), []).append(
+                            (node, line, col))
+            for key, acqs in sites.items():
+                handler_acqs = [a for a in acqs if a[0].qual in hr]
+                main_acqs = [a for a in acqs if a[0].qual in ml]
+                if not handler_acqs or not main_acqs:
+                    continue
+                clskey, attr = key
+                for node, line, col in handler_acqs:
+                    out.append(Finding(
+                        self.id, ctx.rel, line, col,
+                        f"non-reentrant threading.Lock "
+                        f"'{clskey[1]}.{attr}' is acquired in "
+                        f"{node.name}(), which is reachable from a signal "
+                        f"handler, while the same lock guards main-thread "
+                        f"call sites (e.g. {main_acqs[0][0].name}()); a "
+                        "signal landing while the main thread holds it "
+                        "self-deadlocks — use threading.RLock"))
+        return out
+
+
+class BlockingIoUnderLock(Rule):
+    uses_graph = True
+    id = "DL102"
+    title = "blocking I/O while holding a shared lock"
+    rationale = ("a sink/emit lock held across subprocess/socket/HTTP "
+                 "calls or sleeps stalls every hot-path emit() caller "
+                 "behind one slow syscall; move the I/O outside the "
+                 "critical section (snapshot under the lock, write after)")
+    severity = "warn"
+
+    BLOCKING_IO_QUALS = {
+        "time.sleep", "os.system",
+        "subprocess.run", "subprocess.Popen", "subprocess.call",
+        "subprocess.check_call", "subprocess.check_output",
+        "socket.create_connection", "urllib.request.urlopen",
+        "requests.get", "requests.post", "requests.request",
+        "http.client.HTTPConnection", "http.client.HTTPSConnection",
+    }
+    # function names that put a method on the emit fan-out even when no
+    # reachability evidence exists (ledger sinks are duck-typed callables)
+    EMITISH = {"emit", "sink", "__call__"}
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        if "Lock(" not in ctx.src:
+            return []   # cheap text gate: no lock construction here
+        out: List[Finding] = []
+        with graph_scope(project, ctx) as g:
+            known = {key for key in g.lock_attrs if key[0][0] == ctx.rel}
+            if not known:
+                return out
+            ml = g.mainline_reachable()
+            acq_funcs: Dict[tuple, List] = {}
+            for node in g.file_nodes(ctx.rel):
+                if node.cls is None:
+                    continue
+                for owner, attr, _, _ in node.lock_acquires:
+                    if owner == "self" and (node.cls, attr) in known:
+                        acq_funcs.setdefault((node.cls, attr),
+                                             []).append(node)
+            for key, nodes in acq_funcs.items():
+                on_emit_path = any(n.qual in ml or n.name in self.EMITISH
+                                   for n in nodes)
+                if not on_emit_path:
+                    continue
+                for node in nodes:
+                    self._scan_with_blocks(node, key[1], ctx, out)
+        return out
+
+    def _scan_with_blocks(self, node, attr: str, ctx: FileContext,
+                          out: List[Finding]) -> None:
+        if node.node is None:
+            return
+        for n in ast.walk(node.node):
+            if not isinstance(n, (ast.With, ast.AsyncWith)):
+                continue
+            holds = any(
+                isinstance(i.context_expr, ast.Attribute)
+                and terminal_name(i.context_expr) == attr
+                and isinstance(i.context_expr.value, ast.Name)
+                and i.context_expr.value.id == "self"
+                for i in n.items)
+            if not holds:
+                continue
+            for call in _calls_same_scope(n):
+                qual = ctx.resolve(dotted_name(call.func))
+                if qual in self.BLOCKING_IO_QUALS:
+                    out.append(self.finding(
+                        ctx, call,
+                        f"blocking call '{qual}' executes while holding "
+                        f"'self.{attr}', a lock the emit fan-out also "
+                        "takes; every hot-path emitter stalls behind this "
+                        "syscall — snapshot under the lock, do the I/O "
+                        "after releasing it"))
+
+
+class NonDaemonThreadNoJoin(Rule):
+    uses_graph = True
+    id = "DL103"
+    title = "non-daemon thread with no join"
+    rationale = ("a non-daemon thread with no join anywhere keeps the "
+                 "interpreter alive after a crash: the run is dead, the "
+                 "pod is billed, and the scheduler sees a healthy "
+                 "process. Mark helpers daemon=True, or join the thread "
+                 "on the shutdown path")
+    severity = "warn"
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        if "Thread(" not in ctx.src:
+            return []   # cheap text gate: no thread construction here
+        out: List[Finding] = []
+        with graph_scope(project, ctx) as g:
+            recs = g.thread_ctors.get(ctx.rel, ())
+            if not recs:
+                return out
+            # join matching is FILE-scoped on the receiver name: a
+            # Watchdog joining its own '_thread' must not vouch for an
+            # unrelated class's '_thread' in another file
+            file_joins = {recv for qual, recv in g.join_sites
+                          if qual.startswith(ctx.rel + "::")}
+            # functions that join SOMETHING: a create-start-join worker
+            # pattern in one function is bounded-lifetime, even when the
+            # ctor (a comprehension, say) can't be bound to the receiver
+            joining_funcs = {qual for qual, _ in g.join_sites}
+            for rec in recs:
+                if rec["daemon_true"]:
+                    continue
+                bind = rec["bind"]
+                if bind and bind in file_joins:
+                    continue
+                if rec["qual"] in joining_funcs:
+                    continue
+                what = (f"thread bound to {bind!r}" if bind
+                        else "unbound thread (constructed and started "
+                             "inline)")
+                out.append(Finding(
+                    self.id, ctx.rel, rec["lineno"], rec["col"],
+                    f"threading.Thread without daemon=True and without a "
+                    f"join ({what}): if the run crashes, this thread "
+                    "keeps the process alive forever — pass daemon=True "
+                    "or join it on the run_end/shutdown path"))
+        return out
+
+
+class SignalHandlerHygiene(Rule):
+    uses_graph = True
+    id = "DL104"
+    title = "unsafe signal handler body / dropped prior handler"
+    rationale = ("logging and stream .flush() are not async-signal-safe "
+                 "(a handler interrupting the io stack re-enters it and "
+                 "corrupts or deadlocks); and installing a handler while "
+                 "discarding signal.signal's return value silently drops "
+                 "a previously-installed hook (a preemption checkpointer, "
+                 "say) — capture and chain it")
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        with graph_scope(project, ctx) as g:
+            handlers = {q for q in g.signal_handlers()
+                        if q.startswith(ctx.rel + "::")}
+            # the text gate only closes the file when the HANDLER root
+            # set (memoized, cross-file) has nothing here either: a
+            # handler body may live in a file that never says 'signal'
+            # (installed from elsewhere), and install-site checks below
+            # require the literal text by construction
+            if not handlers and "signal" not in ctx.src:
+                return out
+            for node in g.file_nodes(ctx.rel):
+                if node.qual in handlers and node.node is not None:
+                    self._scan_handler_body(node, ctx, out)
+            for rec in g.signal_installs.get(ctx.rel, ()):
+                self._check_chaining(rec, g, ctx, out)
+        return out
+
+    def _scan_handler_body(self, node, ctx: FileContext,
+                           out: List[Finding]) -> None:
+        for call in _calls_same_scope(node.node):
+            qual = ctx.resolve(dotted_name(call.func))
+            tname = terminal_name(call.func)
+            hit = None
+            if qual.split(".")[0] == "logging" or (
+                    qual.startswith("log") and tname in (
+                        "debug", "info", "warning", "error", "exception",
+                        "critical")):
+                hit = f"logging call '{qual}'"
+            elif tname == "flush":
+                hit = f"stream flush '{dotted_name(call.func)}()'"
+            if hit:
+                out.append(self.finding(
+                    ctx, call,
+                    f"{hit} inside the signal handler {node.name}(): "
+                    "logging/io are not reentrant — a signal landing "
+                    "mid-write re-enters the io stack and corrupts or "
+                    "deadlocks; set a flag and do the work on the main "
+                    "code path"))
+
+    def _check_chaining(self, rec, g, ctx: FileContext,
+                        out: List[Finding]) -> None:
+        if rec["result_used"]:
+            return
+        handler = rec["handler"]
+        installs_new = isinstance(handler, ast.Lambda)
+        if isinstance(handler, (ast.Name, ast.Attribute)):
+            node = g.funcs.get(rec["qual"])
+            if node is not None:
+                targets, _ = g.resolve(node, dotted_name(handler))
+                installs_new = bool(targets)
+        if installs_new:
+            out.append(Finding(
+                self.id, ctx.rel, rec["lineno"], rec["col"],
+                "signal.signal() installs a new handler but discards the "
+                "return value: any previously-installed handler (a "
+                "preemption checkpoint hook, a supervisor's own cleanup) "
+                "is silently dropped — capture the previous handler and "
+                "chain it from yours"))
+
+
 RULES: List[Rule] = [HostDivergentCollectives(), HotLoopHostSync(),
                      UnknownMeshAxis(), TracedSideEffect(), PrngHygiene(),
-                     LedgerSchema()]
+                     LedgerSchema(), DonatedBufferReuse(),
+                     SignalLockDeadlock(), BlockingIoUnderLock(),
+                     NonDaemonThreadNoJoin(), SignalHandlerHygiene()]
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in RULES}

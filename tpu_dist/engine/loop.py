@@ -439,6 +439,7 @@ class Trainer:
         import math
 
         with self.obs.tracer.span("device"):
+            # distlint: disable=DL002 -- THE drain boundary: the one sanctioned fetch point of the loop
             fetched = jax.device_get([m for m, _ in pending])
         device_s = self.obs.tracer.pop().get("device", 0.0)
         total_steps = sum(info["n_steps"] for _, info in pending) or 1
@@ -724,6 +725,7 @@ class Trainer:
             win_sh = NamedSharding(self.mesh, P(None, "data"))
             idx_d = assemble_global(win_sh, np.ascontiguousarray(idx))
             valid_d = assemble_global(win_sh, np.ascontiguousarray(valid))
+            # distlint: disable=DL002 -- one-dispatch eval: the eval drain boundary
             m = jax.device_get(self.window_eval_step(
                 self.state.params, self.state.batch_stats,
                 *self._val_data_dev, idx_d, valid_d))
@@ -739,6 +741,7 @@ class Trainer:
                     valid))
             sums = {"loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0,
                     "count": 0.0}
+            # distlint: disable=DL002 -- eval drain boundary: pending eval metrics fetched in one batch
             for m in jax.device_get(pending):
                 for k in sums:
                     sums[k] += float(m[k])

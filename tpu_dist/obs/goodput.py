@@ -368,7 +368,11 @@ class GoodputMonitor:
         self._samples = 0
         self._last_step_ts: Optional[float] = None
         self._last_emit_ts: Optional[float] = None
-        self._lock = threading.Lock()
+        # RLock, not Lock: run_end's SIGTERM path calls emit_goodput() on
+        # the main thread — if the signal lands while that same thread is
+        # inside sink() holding this lock, a plain Lock would self-deadlock
+        # (the exact hazard Ledger._lock documents; distlint DL101)
+        self._lock = threading.RLock()
 
     def sink(self, rec: dict) -> None:
         ev = rec.get("event")

@@ -62,7 +62,11 @@ class _Metric:
         self.name = name
         self.help = help_text
         self._series: Dict[Tuple[Tuple[str, str], ...], object] = {}
-        self._lock = threading.Lock()
+        # RLock, not Lock: RunObs.run_end's SIGTERM path snapshots every
+        # metric on the main thread — if the signal lands while that same
+        # thread is inside labels()/render() (the ledger-sink fan-out), a
+        # plain Lock would self-deadlock (distlint DL101)
+        self._lock = threading.RLock()
 
     def labels(self, **labels):
         key = tuple(sorted(labels.items()))
@@ -233,7 +237,9 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: Dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        # RLock for the same reason as _Metric._lock: snapshot() runs on
+        # the SIGTERM handler path while _get() serves main-thread sinks
+        self._lock = threading.RLock()
 
     def _get(self, cls, name, help_text, **kw):
         with self._lock:
