@@ -131,6 +131,27 @@ def lm_geometry():
         grad_bucket_mb=float(os.environ.get("BENCH_GRAD_BUCKET_MB", "0")))
 
 
+def apply_fused_quant_knob():
+    """BENCH_FUSED_QUANT=1/0 forces the fused Pallas int8 kernel on/off
+    (ops.quant.set_fused_quant; unset = auto: fused on TPU). Must run
+    BEFORE any step function is built — the dispatch is trace-time static.
+    Returns the active state for the config block."""
+    knob = os.environ.get("BENCH_FUSED_QUANT", "")
+    from tpu_dist.ops.quant import fused_quant_active, set_fused_quant
+    if knob != "":
+        set_fused_quant(knob == "1")
+    return fused_quant_active()
+
+
+def prefetch_enabled() -> bool:
+    """BENCH_PREFETCH=1: stream each trial's batch host->device through
+    data.loader.DevicePrefetcher instead of pre-placing it in HBM, so the
+    step records carry a MEASURED data_s (the consumer's queue wait —
+    ~0 when staging overlaps the previous trial's compute) and the
+    headline JSON a 'prefetch' overlap block."""
+    return os.environ.get("BENCH_PREFETCH") == "1"
+
+
 
 def health_block(metrics, k: int) -> dict:
     """Headline-JSON numerical-health block from the fused step probes
@@ -245,6 +266,7 @@ def lm_build():
     key = jax.random.PRNGKey(1)
     return dict(window=window, state=state, rows_dev=rows_dev,
                 idx_dev=idx_dev, key=key, params=params, mesh=mesh,
+                rows_host=rows, idx_host=idx,
                 n_chips=n_chips, L=L, d_model=d_model, layers=layers,
                 batch=batch, k=k, attn_kind=attn_kind,
                 loss_chunk=loss_chunk, quant=quant, tp_impl=tp_impl,
@@ -260,7 +282,10 @@ def lm_bench():
     BENCH_SEQ_LEN (2048), BENCH_D_MODEL (1024), BENCH_LAYERS (8),
     BENCH_HEADS (8), BENCH_VOCAB (32000), BENCH_LM_BATCH per chip (8),
     BENCH_ATTN full|blockwise|flash (flash), BENCH_REMAT=1,
-    BENCH_OPTIMIZER sgd|adamw|fused_adamw, BENCH_LOSS_CHUNK.
+    BENCH_OPTIMIZER sgd|adamw|fused_adamw, BENCH_LOSS_CHUNK,
+    BENCH_FUSED_QUANT 1|0 (force the fused Pallas int8 kernel on/off;
+    unset = auto), BENCH_PREFETCH=1 (stream trial batches host->device
+    through data.loader.DevicePrefetcher — data_s becomes measured).
     Completion is forced with a device_get readback (block_until_ready does
     not reliably block across tunneled controllers); the ~0.1s readback is
     amortized over the multi-second window.
@@ -275,6 +300,16 @@ def lm_bench():
             "dense); use BENCH_ARCH=transformer_lm with BENCH_* geometry "
             "knobs")
 
+    if os.environ.get("BENCH_FUSED_QUANT", "") != "" \
+            and (os.environ.get("BENCH_QUANT") or "none") != "int8":
+        # same refuse-rather-than-mislead rule as the conv-arch guard:
+        # forcing the fused kernel with no int8 matmuls in the program
+        # would publish a plain bf16 number under a fused-int8 intent
+        raise SystemExit(
+            "BENCH_FUSED_QUANT only means something with BENCH_QUANT=int8 "
+            f"(got BENCH_QUANT={os.environ.get('BENCH_QUANT') or 'none'}); "
+            "unset it or set BENCH_QUANT=int8")
+    fused_quant = apply_fused_quant_knob()  # BEFORE lm_build traces steps
     b = lm_build()
     window, state = b["window"], b["state"]
     rows_dev, idx_dev, key = b["rows_dev"], b["idx_dev"], b["key"]
@@ -283,12 +318,32 @@ def lm_bench():
     attn_kind, loss_chunk, quant = b["attn_kind"], b["loss_chunk"], b["quant"]
     tp_impl, grad_bucket_mb = b["tp_impl"], b["grad_bucket_mb"]
     trials = int(os.environ.get("BENCH_TRIALS", "3"))
+    prefetcher = None
+    if prefetch_enabled():
+        # stream each trial's (rows, idx) host->device on the prefetcher's
+        # producer thread; the consumer wait IS the step record's data_s
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from tpu_dist.data.loader import DevicePrefetcher
+        from tpu_dist.parallel.mesh import replicated
+        mesh = b["mesh"]
+        idx_sh = NamedSharding(mesh, P(None, "data"))
+
+        def stage(batch_pair):
+            r, ix = batch_pair
+            return (jax.device_put(r, replicated(mesh)),
+                    jax.device_put(ix, idx_sh))
+        prefetcher = DevicePrefetcher(
+            ((b["rows_host"], b["idx_host"]) for _ in range(trials)),
+            put=stage)
+        trial_batches = iter(prefetcher)
 
     # analytical model FLOPs (tpu_dist.utils.mfu.lm_flops_per_token; XLA's
     # cost model undercounts scan bodies and cannot cost Pallas kernels)
     flops_per_token = lm_flops_per_token(b["params"], layers, L, d_model)
-    ledger, ledger_path, goodput_acc = bench_ledger("bench_lm",
-                                                    lm_geometry())
+    ledger, ledger_path, goodput_acc = bench_ledger(
+        "bench_lm", {**lm_geometry(),
+                     "fused_quant": fused_quant and quant == "int8",
+                     "prefetch": prefetcher is not None})
     t_warm = time.perf_counter()
     state, m = window(state, rows_dev, idx_dev, key)           # compile+warm
     jax.device_get(m)
@@ -318,13 +373,17 @@ def lm_bench():
     rates, phases = [], []
     for i in range(trials):
         t0 = time.perf_counter()
+        if prefetcher is not None:
+            rows_dev, idx_dev = next(trial_batches)
+        data_s = time.perf_counter() - t0
         state, m = window(state, rows_dev, idx_dev, key)
-        disp_s = time.perf_counter() - t0
+        disp_s = time.perf_counter() - t0 - data_s
         jax.device_get(m)  # forces completion through the tunnel
         dt = time.perf_counter() - t0
         rates.append(k * batch * L / dt)
-        phases.append({"data_s": 0.0, "dispatch_s": round(disp_s, 6),
-                       "device_s": round(dt - disp_s, 6)})
+        phases.append({"data_s": round(data_s, 6),
+                       "dispatch_s": round(disp_s, 6),
+                       "device_s": round(dt - data_s - disp_s, 6)})
         if ledger:
             # ledger MFU uses the engines' nominal-peak fallback (non-null
             # on CPU); the headline JSON's mfu stays real-peak-only
@@ -334,12 +393,17 @@ def lm_bench():
                         throughput=round(rates[-1] / n_chips, 1),
                         unit="tok/s/chip",
                         mfu=t_tf / effective_peak_tflops()[0],
-                        steps_in_dispatch=k, data_s=0.0,
+                        steps_in_dispatch=k,
+                        data_s=phases[-1]["data_s"],
                         dispatch_s=phases[-1]["dispatch_s"],
                         device_s=phases[-1]["device_s"],
-                        comm_s=None)
+                        comm_s=None, fused=fused_quant and quant == "int8")
     best = max(rates)
     best_phases = phases[rates.index(best)]
+    prefetch_stats = None
+    if prefetcher is not None:
+        prefetcher.close()
+        prefetch_stats = prefetcher.stats()
     # the headline carries the last trial's numerical-health block
     health = health_block(m, k)
     tok_chip = best / n_chips
@@ -379,11 +443,14 @@ def lm_bench():
         "config": {"tp_impl": tp_impl, "grad_bucket_mb": grad_bucket_mb,
                    "quant": quant, "attn": attn_kind,
                    "loss_chunk": loss_chunk,
+                   "fused_quant": fused_quant and quant == "int8",
+                   "prefetch": prefetcher is not None,
                    "tp_degree": (b["mesh"].shape["model"]
                                  if tp_impl == "ring" else 1)},
         "mfu": round(mfu, 4) if mfu else None,
         "tflops": round(tflops, 2) if tflops else None,
         "phases": best_phases,
+        "prefetch": prefetch_stats,
         "health": health,
         "goodput": goodput_block(goodput_acc),
         "ledger": ledger_path,
@@ -409,6 +476,7 @@ def build(model_kwargs, batch, k):
                          **model_kwargs)
     params, batch_stats = init_model(model, jax.random.PRNGKey(0), (2, IMG, IMG, 3))
     tx = make_optimizer(0.1, 0.9, 1e-4, steps_per_epoch=100)
+    # distlint: disable=DL008 -- one-time state replication at bench setup, not a per-step upload
     state = jax.device_put(TrainState.create(params, batch_stats, tx),
                            replicated(mesh))
     transform = make_transform(CIFAR10_MEAN, CIFAR10_STD, dtype=jnp.bfloat16)
@@ -421,9 +489,12 @@ def build(model_kwargs, batch, k):
     images = rng.integers(0, 255, (k, batch, IMG, IMG, 3)).astype(np.uint8)
     labels = rng.integers(0, NUM_CLASSES, (k, batch)).astype(np.int32)
     sh_img = NamedSharding(mesh, P(None, "data"))
-    images = jax.device_put(images, sh_img)
-    labels = jax.device_put(labels, sh_img)
-    return step, single, state, images, labels
+    # distlint: disable=DL008 -- HBM-resident bench design: the whole K-step window is pre-placed before timing (BENCH_PREFETCH=1 is the streamed mode)
+    images_dev = jax.device_put(images, sh_img)
+    # distlint: disable=DL008 -- HBM-resident bench design: pre-placed window (see images_dev)
+    labels_dev = jax.device_put(labels, sh_img)
+    return (step, single, state, images_dev, labels_dev,
+            (images, labels), sh_img)
 
 
 def flops_per_step(single, state, images, labels, key,
@@ -447,7 +518,8 @@ def measure(model_kwargs, per_chip_batch, k, trials, with_hlo=False):
 
     n_chips = jax.device_count()
     batch = per_chip_batch * n_chips
-    step, single, state, images, labels = build(model_kwargs, batch, k)
+    (step, single, state, images, labels,
+     host_batch, sh_img) = build(model_kwargs, batch, k)
     key = jax.random.PRNGKey(0)
     # with_hlo only on the headline run: the sweep discards everything
     # past the rate, and the optimized-HLO text can run to megabytes
@@ -460,21 +532,42 @@ def measure(model_kwargs, per_chip_batch, k, trials, with_hlo=False):
     # distlint: disable=DL002 -- compile+warm barrier before the timed window
     jax.block_until_ready(metrics)
 
+    prefetcher = None
+    if prefetch_enabled():
+        # per-trial host->device staging on the producer thread: data_s
+        # below becomes a measured queue wait instead of the synthetic 0.0
+        from tpu_dist.data.loader import DevicePrefetcher
+
+        def stage(pair):
+            return (jax.device_put(pair[0], sh_img),
+                    jax.device_put(pair[1], sh_img))
+        prefetcher = DevicePrefetcher(
+            (host_batch for _ in range(trials)), put=stage)
+        trial_batches = iter(prefetcher)
+
     rates, phases = [], []
     for _ in range(trials):
         t0 = time.perf_counter()
+        if prefetcher is not None:
+            images, labels = next(trial_batches)
+        data_s = time.perf_counter() - t0
         state, metrics = step(state, images, labels, key)
-        disp_s = time.perf_counter() - t0
+        disp_s = time.perf_counter() - t0 - data_s
         # distlint: disable=DL002 -- the timed measurement barrier - benches measure the sync
         jax.block_until_ready(metrics)
         dt = time.perf_counter() - t0
         rates.append(batch * k / dt)
-        phases.append({"data_s": 0.0, "dispatch_s": round(disp_s, 6),
-                       "device_s": round(dt - disp_s, 6)})
+        phases.append({"data_s": round(data_s, 6),
+                       "dispatch_s": round(disp_s, 6),
+                       "device_s": round(dt - data_s - disp_s, 6)})
+    prefetch_stats = None
+    if prefetcher is not None:
+        prefetcher.close()
+        prefetch_stats = prefetcher.stats()
     best_phases = phases[rates.index(max(rates))]
     return (max(rates), sorted(rates), step_flops, batch, best_phases,
             list(zip(rates, phases)),  # trials in timing order (ledger)
-            health_block(metrics, k), st.get("hlo"))
+            health_block(metrics, k), st.get("hlo"), prefetch_stats)
 
 
 def main():
@@ -488,11 +581,12 @@ def main():
         lm_bench()
         return
 
-    if os.environ.get("BENCH_QUANT", "none") not in ("", "none"):
+    if os.environ.get("BENCH_QUANT", "none") not in ("", "none") \
+            or os.environ.get("BENCH_FUSED_QUANT", "") != "":
         # refuse rather than silently publish a bf16 number under the
         # user's int8 intent: the conv models have no quantized path
         raise SystemExit(
-            f"BENCH_QUANT={os.environ['BENCH_QUANT']} applies to the LM "
+            "BENCH_QUANT/BENCH_FUSED_QUANT apply to the LM "
             f"bench only (BENCH_ARCH=transformer_lm); BENCH_ARCH={ARCH} "
             "has no quantized path")
     if os.environ.get("BENCH_TP_IMPL", "gspmd") not in ("", "gspmd") \
@@ -587,13 +681,15 @@ def main():
         kwargs = {}
         default_model = True
     (best, rates, window_flops, batch, phases, trial_data, health,
-     step_hlo) = measure(kwargs, per_chip_batch, k, trials,
-                         with_hlo=bool(os.environ.get("BENCH_LEDGER")))
+     step_hlo, prefetch_stats) = measure(
+         kwargs, per_chip_batch, k, trials,
+         with_hlo=bool(os.environ.get("BENCH_LEDGER")))
     ips_per_chip, tflops, mfu, fpi = report("headline", best, rates,
                                             window_flops, batch)
     ledger, ledger_path, goodput_acc = bench_ledger(
         "bench_image", {"arch": ARCH, "img": IMG, "classes": NUM_CLASSES,
                         "per_chip_batch": per_chip_batch, "k": k,
+                        "prefetch": prefetch_stats is not None,
                         **{kk: getattr(v, "__name__", str(v))
                            for kk, v in kwargs.items()}})
     if ledger:
@@ -615,7 +711,7 @@ def main():
             ledger.emit("step", step=i, loss=None,
                         throughput=round(r_chip, 1), unit="img/s/chip",
                         mfu=round(tf / eff_peak, 6) if tf else None,
-                        steps_in_dispatch=k, data_s=0.0,
+                        steps_in_dispatch=k, data_s=ph["data_s"],
                         dispatch_s=ph["dispatch_s"],
                         device_s=ph["device_s"], comm_s=None)
         ledger.emit("run_end", steps=trials * k,
@@ -641,6 +737,7 @@ def main():
             "tflops": round(tflops, 2) if tflops else None,
             "flops_per_img": round(fpi) if fpi else None,
             "phases": phases,
+            "prefetch": prefetch_stats,
             "health": health,
             "goodput": goodput_block(goodput_acc),
             "ledger": ledger_path,
@@ -676,6 +773,7 @@ def main():
         "tflops": round(tflops, 2) if tflops else None,
         "flops_per_img": round(fpi) if fpi else None,
         "phases": phases,
+        "prefetch": prefetch_stats,
         "health": health,
         "goodput": goodput_block(goodput_acc),
         "ledger": ledger_path,

@@ -1,0 +1,23 @@
+"""DL008 positive fixture: bare device_put on the hot step path.
+
+``train_step`` is a real jit product, so the loop is hot with graph
+evidence; the inline ``jax.device_put`` charges the upload to the step
+loop's critical path (lexical finding) and ``stage()`` is called from the
+loop body, so its device_put is caught by the reachability pass too.
+"""
+
+import jax
+
+train_step = jax.jit(lambda s, b: s)
+
+
+def stage(batch, sharding):
+    return jax.device_put(batch, sharding)       # reachable from the loop
+
+
+def train_epoch(loader, state, sharding):
+    for batch in loader:
+        dev = jax.device_put(batch, sharding)    # upload on the hot path
+        state = train_step(state, dev)
+        state = train_step(state, stage(batch, sharding))
+    return state

@@ -1,0 +1,18 @@
+"""DL008 negative fixture: uploads staged off the hot path.
+
+One-time state placement BEFORE the loop is fine (DL008 only looks inside
+hot loop bodies and functions reachable from them), and batches arriving
+already device-resident (the loader's prefetcher staged them on its
+producer thread) give the step loop nothing to upload.
+"""
+
+import jax
+
+train_step = jax.jit(lambda s, b: s)
+
+
+def train_epoch(prefetched, state, sharding):
+    state = jax.device_put(state, sharding)      # one-time, before the loop
+    for batch in prefetched:                     # already device-resident
+        state = train_step(state, batch)
+    return state

@@ -43,6 +43,7 @@ def _full_lint():
 # one is a detection regression; both should fail loudly here
 EXPECTED_BAD_COUNTS = {"DL001": 2, "DL002": 3, "DL003": 3,
                        "DL004": 4, "DL005": 3, "DL006": 4, "DL007": 2,
+                       "DL008": 2,
                        "DL101": 1, "DL102": 2, "DL103": 2, "DL104": 3}
 
 

@@ -189,6 +189,7 @@ def test_multi_step_equals_sequential_steps():
     s_seq = state0
     total = 0.0
     for i in range(k):
+        # distlint: disable=DL008 -- CPU equivalence test stages its own per-step operands; no input pipeline in play
         s_seq, m = single(s_seq, jax.device_put(imgs[i], sh),
                           jax.device_put(lbls[i], sh), key)
         # distlint: disable=DL002 -- CPU test: per-step loss assertion needs the value now
@@ -269,6 +270,7 @@ def test_indexed_multi_step_equals_host_batches():
     sh = batch_sharding(mesh)
     s_seq = state0
     for i in range(k):
+        # distlint: disable=DL008 -- CPU equivalence test stages its own per-step operands; no input pipeline in play
         s_seq, _ = single(s_seq, jax.device_put(images_all[idx[i]], sh),
                           jax.device_put(labels_all[idx[i]], sh), key)
 

@@ -139,3 +139,47 @@ def test_blockwise_non_divisible_length_fits_gcd():
     ref = full_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+# ---- int8-KV decode-path variant (round 9) --------------------------------
+
+def test_int8kv_flash_matches_full_on_dequantized_kv():
+    """The int8-KV kernel's only approximation is the KV quantization
+    itself: against full attention over the DEQUANTIZED keys/values the
+    outputs must agree to flash tolerance (the in-kernel per-tile dequant
+    is exact), and against the fp KV the error stays at int8 scale."""
+    from tpu_dist.ops.flash_attention import (int8kv_flash_attention_fn,
+                                              quantize_kv)
+
+    q, k, v = _qkv(7)
+    kv = quantize_kv(k, v)
+    kq, ks, vq, vs = kv
+    assert kq.dtype == jnp.int8 and ks.shape == k.shape[:3]
+    k_dq = kq.astype(jnp.float32) * ks[..., None]
+    v_dq = vq.astype(jnp.float32) * vs[..., None]
+    out = int8kv_flash_attention_fn(block_q=64, block_k=64)(q, kv)
+    ref = full_attention(q, k_dq, v_dq)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # int8 KV vs fp KV: bounded by the quantization step, not exact
+    fp = full_attention(q, k, v)
+    assert float(jnp.max(jnp.abs(out - fp))) < 0.15
+
+
+def test_int8kv_flash_decode_offsets():
+    """The decode shape: one new query block attending into a longer
+    quantized cache via q_offset (causal against absolute positions)."""
+    from tpu_dist.ops.flash_attention import (int8kv_flash_attention_fn,
+                                              quantize_kv)
+
+    q, k, v = _qkv(8)
+    kv = quantize_kv(k, v)
+    kq, ks, vq, vs = kv
+    k_dq = kq.astype(jnp.float32) * ks[..., None]
+    v_dq = vq.astype(jnp.float32) * vs[..., None]
+    tail = q[:, 64:]                 # last 64 positions are the new block
+    out = int8kv_flash_attention_fn(block_q=32, block_k=64)(
+        tail, kv, q_offset=64)
+    ref = full_attention(q, k_dq, v_dq)[:, 64:]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -180,6 +180,7 @@ def _poisoned_trainer(tmp_path, health, poison_batch):
     return tr, cfg
 
 
+@pytest.mark.slow  # tier-1 budget (PR 9): 24s e2e; skip-gating itself is unit-pinned by test_probes_ride_the_metrics_and_skip_gates_the_update and the run/ledger mechanics by the cheaper halt twin below — offsets the new pallas_quant/prefetcher/int8kv tests
 def test_health_skip_nan_injection_lm_run(tmp_path):
     """Acceptance: with health=skip, the NaN-grad step is skipped (params
     bit-identical, data+RNG advance), the run completes with exactly one

@@ -61,6 +61,34 @@ def test_fused_sgd_matches_optax_over_tree():
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_fused_sgd_clip_matches_optax_chain():
+    """clip_norm > 0 reproduces optax clip_by_global_norm -> sgd exactly:
+    the clip scale is computed once per step over the whole tree and fused
+    into the kernel's update sweep (ops.pallas_sgd.clip_scale). Large grads
+    force the clip branch; the final tiny-grad step checks identity."""
+    from tpu_dist.ops.optim import make_optimizer
+
+    rng = np.random.default_rng(3)
+    params = {"a": jnp.asarray(rng.normal(size=(40, 16)), jnp.float32),
+              "b": {"w": jnp.asarray(rng.normal(size=(130,)), jnp.float32)}}
+    clip = 0.25
+    fused = FusedSGD(lambda s: 0.05, momentum=0.9, weight_decay=1e-4,
+                     clip_norm=clip, interpret=True)
+    tx = make_optimizer(0.05, 0.9, 1e-4, steps_per_epoch=10 ** 6,
+                        grad_clip=clip)
+    fp, fstate = params, fused.init(params)
+    op, ostate = params, tx.init(params)
+    for step, mag in enumerate((4.0, 1e-3)):   # clip branch, then identity
+        grads = jax.tree.map(lambda p: jnp.asarray(
+            mag * rng.normal(size=p.shape), jnp.float32), params)
+        fp, fstate = fused.apply(fp, grads, fstate, jnp.int32(step))
+        updates, ostate = tx.update(grads, ostate, op)
+        op = jax.tree.map(lambda p, u: p + u, op, updates)
+        for k1, k2 in zip(jax.tree.leaves(fp), jax.tree.leaves(op)):
+            np.testing.assert_allclose(np.asarray(k1), np.asarray(k2),
+                                       rtol=1e-5, atol=1e-6)
+
+
 def test_engine_with_fused_sgd_converges():
     from tpu_dist.configs import TrainConfig
     from tpu_dist.engine import Trainer

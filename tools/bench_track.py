@@ -71,12 +71,22 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
         if rnd is None:
             m = re.search(r"_r0*(\d+)\.json$", os.path.basename(path))
             rnd = int(m.group(1)) if m else None
+        phases = parsed.get("phases") if isinstance(parsed.get("phases"),
+                                                    dict) else {}
+        # pre-round-9 headlines hardcoded data_s: 0.0 (device-resident
+        # bench, no measurement); a real measured wait never rounds to
+        # exactly 0 — treat the placeholder as absent so the gate judges
+        # measured-vs-measured, never measured-vs-synthetic
+        data_s = phases.get("data_s")
+        if data_s == 0:
+            data_s = None
         points.append({
             "metric": parsed["metric"],
             "value": value,
             "unit": parsed.get("unit"),
             "mfu": parsed.get("mfu"),
             "vs_baseline": parsed.get("vs_baseline"),
+            "data_s": data_s,
             "round": rnd,
             "file": os.path.basename(path),
         })
@@ -86,13 +96,24 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
     return points
 
 
-def track(points: List[dict], threshold_pct: float) -> dict:
+def track(points: List[dict], threshold_pct: float,
+          data_s_slack: float = 0.05) -> dict:
     """Group points by metric and judge the newest against the trailing
-    best: {'metrics': {name: {...}}, 'ok': bool}."""
+    best: {'metrics': {name: {...}}, 'ok': bool}.
+
+    Beside the headline value, the newest point's ``data_s`` (the bench's
+    best-trial input wait, headline JSON ``phases.data_s``) is judged
+    against the best (lowest) prior: a rise of more than ``data_s_slack``
+    seconds fails the gate even when throughput still looks fine — the
+    apex-prefetcher class of bug where the input pipeline silently stops
+    overlapping but a compute-bound trial hides it for one more round.
+    Points without phases (pre-round-6 history) abstain rather than judge.
+    """
     by_metric: dict = {}
     for p in points:
         by_metric.setdefault(p["metric"], []).append(p)
-    report = {"metrics": {}, "ok": True, "threshold_pct": threshold_pct}
+    report = {"metrics": {}, "ok": True, "threshold_pct": threshold_pct,
+              "data_s_slack": data_s_slack}
     for name, series in by_metric.items():
         latest = series[-1]
         prior = series[:-1]
@@ -102,8 +123,15 @@ def track(points: List[dict], threshold_pct: float) -> dict:
         if best_prior:
             drop_pct = (best_prior - latest["value"]) / best_prior * 100.0
             regressed = drop_pct > threshold_pct
+        prior_data = [p["data_s"] for p in prior
+                      if p.get("data_s") is not None]
+        data_best = min(prior_data, default=None)
+        data_regressed = (data_best is not None
+                          and latest.get("data_s") is not None
+                          and latest["data_s"] > data_best + data_s_slack)
         rounds = [{"round": p["round"], "value": p["value"],
                    "mfu": p["mfu"], "file": p["file"],
+                   "data_s": p.get("data_s"),
                    "delta_pct": (None if i == 0 or not series[i - 1]["value"]
                                  else (p["value"] / series[i - 1]["value"]
                                        - 1.0) * 100.0)}
@@ -112,8 +140,11 @@ def track(points: List[dict], threshold_pct: float) -> dict:
             "unit": latest["unit"], "rounds": rounds,
             "latest": latest["value"], "best_prior": best_prior,
             "drop_pct": drop_pct, "regressed": regressed,
+            "data_s_latest": latest.get("data_s"),
+            "data_s_best_prior": data_best,
+            "data_s_regressed": data_regressed,
         }
-        if regressed:
+        if regressed or data_regressed:
             report["ok"] = False
     return report
 
@@ -139,6 +170,12 @@ def render(report: dict, out=print) -> None:
             out(f"  -> {verdict}")
         else:
             out("  -> single point; nothing to judge")
+        if m.get("data_s_best_prior") is not None \
+                and m.get("data_s_latest") is not None:
+            verdict = ("DATA_S REGRESSED" if m["data_s_regressed"] else "ok")
+            out(f"  -> data_s {verdict}: latest {m['data_s_latest']:.4f}s "
+                f"vs best prior {m['data_s_best_prior']:.4f}s (slack "
+                f"{report['data_s_slack']:g}s)")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -154,6 +191,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--threshold-pct", type=float, default=5.0,
                     help="fail when the newest point drops more than this "
                     "%% below the metric's trailing best (default 5)")
+    ap.add_argument("--data-s-slack", type=float, default=0.05,
+                    help="fail when the newest point's phases.data_s rises "
+                    "more than this many seconds above the metric's best "
+                    "prior (input-pipeline regression gate; default 0.05)")
     ap.add_argument("--check", action="store_true",
                     help="exit 1 on any regressed metric (the CI gate; "
                     "implied by --headline)")
@@ -180,13 +221,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"bench_track: headline {args.headline} yielded no usable "
               "point — the run under test cannot be judged", file=sys.stderr)
         return 2
-    report = track(points, args.threshold_pct)
+    report = track(points, args.threshold_pct,
+                   data_s_slack=args.data_s_slack)
     if args.json:
         print(json.dumps(report))
     else:
         render(report)
     if (args.check or args.headline) and not report["ok"]:
-        bad = [k for k, m in report["metrics"].items() if m["regressed"]]
+        bad = [k for k, m in report["metrics"].items()
+               if m["regressed"] or m.get("data_s_regressed")]
         print(f"bench_track: REGRESSION in {bad}", file=sys.stderr)
         return 1
     return 0

@@ -22,6 +22,9 @@ DL006  every ``*ledger*.emit(...)`` call site conforms to EVENT_SCHEMA
        (the absorbed tools/check_ledger_schema check).
 DL007  buffers donated to a jitted call (``donate_argnums``) referenced
        afterwards — the device buffer may already be reused by XLA.
+DL008  bare ``jax.device_put`` on the hot step path outside the loader /
+       prefetcher — the copy dispatch belongs on the producer thread
+       (data.loader.DevicePrefetcher), not the step loop.  [warn tier]
 
 The DL1xx family rides the cross-file call graph + reachability pass
 (core.CallGraph): concurrency and signal-safety hazards in the threaded
@@ -846,6 +849,76 @@ class DonatedBufferReuse(Rule):
         return
 
 
+# ------------------------------------------------------------------ DL008
+class HotLoopDevicePut(Rule):
+    uses_graph = True
+    id = "DL008"
+    title = "bare device_put on the hot step path"
+    severity = "warn"
+    rationale = ("a device_put dispatched from the step loop charges the "
+                 "host->device copy to the consumer's critical path — the "
+                 "data_s the round-9 DevicePrefetcher exists to hide; "
+                 "stage uploads through data.loader (DevicePrefetcher / "
+                 "prefetch_to_device) so the dispatch rides the producer "
+                 "thread")
+
+    # the loader IS the staging layer: its device_put/
+    # make_array_from_process_local_data call sites are the one legitimate
+    # home for hot-path uploads (every engine rides them via
+    # prefetch_to_device / stream_prefetch)
+    LOADER_FILES = {"tpu_dist/data/loader.py"}
+    PUT_QUALS = {"jax.device_put", "device_put"}
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        if "device_put" not in ctx.src:
+            return []   # cheap text gate before opening the graph
+        if ctx.rel.replace("\\", "/") in self.LOADER_FILES:
+            return []
+        helper = RULES_BY_ID["DL002"]   # shares the derived hot set
+        out: List[Finding] = []
+        with graph_scope(project, ctx) as g:
+            reaches = g.reaches_traced()
+            traced = g.traced_funcs()
+            hot = helper._hot_funcs(g, reaches, traced)
+            for node in g.file_nodes(ctx.rel):
+                if node.qual in traced:
+                    continue
+                for loop in node.loops:
+                    if helper._loop_is_hot(node, loop, g, reaches, traced):
+                        for stmt in loop.body + loop.orelse:
+                            self._scan_stmt(stmt, node.name, ctx, out,
+                                            lexical=True)
+                if node.node is not None and node.qual in hot:
+                    for stmt in node.node.body:
+                        self._scan_stmt(stmt, node.name, ctx, out,
+                                        lexical=False)
+        seen: Set[Tuple[int, int]] = set()
+        uniq: List[Finding] = []
+        for f in sorted(out, key=lambda f: (f.line, f.col)):
+            if (f.line, f.col) not in seen:
+                seen.add((f.line, f.col))
+                uniq.append(f)
+        return uniq
+
+    def _scan_stmt(self, stmt: ast.stmt, fn_name: str, ctx: FileContext,
+                   out: List[Finding], lexical: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # separate node: the reachability pass covers it
+        for child in ast.iter_child_nodes(stmt):
+            self._scan_stmt(child, fn_name, ctx, out, lexical)
+        if isinstance(stmt, ast.Call) \
+                and ctx.resolve(dotted_name(stmt.func)) in self.PUT_QUALS:
+            where = (f"inside the hot loop of {fn_name}()" if lexical
+                     else f"in {fn_name}(), reachable from a hot step loop")
+            out.append(self.finding(
+                ctx, stmt,
+                f"bare device_put {where}: the upload dispatch runs on "
+                "the consumer thread and lands in data_s — stage it "
+                "through data.loader.DevicePrefetcher/prefetch_to_device "
+                "(or pin with a reason if this copy is deliberate)"))
+
+
 # ------------------------------------------------ DL101-DL104 concurrency
 class SignalLockDeadlock(Rule):
     uses_graph = True
@@ -1096,6 +1169,7 @@ class SignalHandlerHygiene(Rule):
 RULES: List[Rule] = [HostDivergentCollectives(), HotLoopHostSync(),
                      UnknownMeshAxis(), TracedSideEffect(), PrngHygiene(),
                      LedgerSchema(), DonatedBufferReuse(),
+                     HotLoopDevicePut(),
                      SignalLockDeadlock(), BlockingIoUnderLock(),
                      NonDaemonThreadNoJoin(), SignalHandlerHygiene()]
 

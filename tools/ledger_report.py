@@ -318,6 +318,48 @@ def summarize(records, out=print):
             out(f"MFU trend: {_fmt_mfu(a)} -> {_fmt_mfu(b)} -> {_fmt_mfu(c)}"
                 f"  (mean {_fmt_mfu(_mean(mfu))})")
             summary["mfu"] = {"thirds": [a, b, c], "mean": _mean(mfu)}
+        ds = [r["data_s"] for r in hot if r.get("data_s") is not None]
+        a, b, c = _thirds(ds)
+        if a is not None:
+            out(f"data wait trend: {a:.4f}s -> {b:.4f}s -> {c:.4f}s per "
+                f"record  (mean {_mean(ds):.4f}s; ~0 means the prefetcher "
+                "hid the host->device copies)")
+            summary["data_s"] = {"thirds": [a, b, c], "mean": _mean(ds)}
+        # fused-kernel attribution: records carrying the boolean `fused`
+        # extra (engines + bench since round 9) split on it, so an MFU
+        # delta is attributable to the fused int8 Pallas kernel from the
+        # ledger alone — no side-channel config needed
+        flagged = [r for r in hot if r.get("fused") is not None]
+        if flagged:
+            groups = {}
+            for r in flagged:
+                groups.setdefault(bool(r["fused"]), []).append(r)
+            split = {}
+            for flag, rs in sorted(groups.items()):
+                split["fused" if flag else "unfused"] = {
+                    "records": len(rs),
+                    "throughput_mean": _mean(
+                        r["throughput"] for r in rs
+                        if r.get("throughput") is not None),
+                    "mfu_mean": _mean(r["mfu"] for r in rs
+                                      if r.get("mfu") is not None)}
+            summary["fused_split"] = split
+            if len(split) == 2:
+                mf, mu = (split["fused"]["mfu_mean"],
+                          split["unfused"]["mfu_mean"])
+                out("fused int8 kernel: "
+                    f"{split['fused']['records']} fused record(s) at MFU "
+                    f"{_fmt_mfu(mf)} vs {split['unfused']['records']} "
+                    f"unfused at {_fmt_mfu(mu)}"
+                    + (f" -> delta {_fmt_mfu(mf - mu)}"
+                       if mf is not None and mu is not None else ""))
+            else:
+                only = next(iter(split))
+                s = split[only]
+                out(f"fused int8 kernel: all {s['records']} flagged "
+                    f"record(s) {only}"
+                    + (f" (MFU mean {_fmt_mfu(s['mfu_mean'])})"
+                       if s["mfu_mean"] is not None else ""))
 
     if epochs:
         out("\nepochs:")

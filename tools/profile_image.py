@@ -42,7 +42,8 @@ def capture(out_dir: str):
     if os.environ.get("BENCH_STEM"):
         kwargs["stem"] = os.environ["BENCH_STEM"]
     batch = per_chip * jax.device_count()
-    step, single, state, images, labels = bench.build(kwargs, batch, k)
+    (step, single, state, images, labels,
+     _host, _sh) = bench.build(kwargs, batch, k)
     key = jax.random.PRNGKey(0)
     state, m = step(state, images, labels, key)     # compile + warm
     jax.block_until_ready(m)

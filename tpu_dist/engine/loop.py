@@ -406,6 +406,11 @@ class Trainer:
         # run observability: ledger + step tracer + skew monitor + hang
         # watchdog, wired from cfg (obs.RunObs); a pathless ledger is free
         self.obs = RunObs("image", cfg, self.mesh, unit="img/s")
+        # whether int8 matmuls (vit_* quant archs) route through the fused
+        # Pallas kernel — trace-time static; stamped into step records so
+        # ledger_report can attribute MFU deltas (LMTrainer twin)
+        from tpu_dist.ops.quant import fused_quant_active
+        self._fused_quant = cfg.quant == "int8" and fused_quant_active()
 
     # ------------------------------------------------------------------
     def log(self, *a, **k):
@@ -468,7 +473,8 @@ class Trainer:
                 data_s=info["data_s"], dispatch_s=info["dispatch_s"],
                 device_s=share, device_flops=self._program_flops,
                 steps_in_dispatch=n,
-                warm=info.get("warm", False), acc1=acc1,
+                warm=info.get("warm", False), fused=self._fused_quant,
+                acc1=acc1,
                 grad_norm=gn, nonfinite_count=nf, update_norm=un,
                 hbm_bytes_in_use=hbm.get("bytes_in_use"),
                 hbm_peak_bytes=hbm.get("peak_bytes_in_use"))

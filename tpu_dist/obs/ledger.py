@@ -50,7 +50,9 @@ EVENT_SCHEMA = {
     # share table, not inside it. None where the engine cannot isolate it
     # (fused GSPMD sync, ring TP interleaving); the explicit bucketed-sync
     # mode stamps a standalone-probe estimate, tools/comm_bench.py measures
-    # it exactly (its programs are pure communication).
+    # it exactly (its programs are pure communication). Engines additionally
+    # stamp a boolean `fused` extra: whether int8 matmuls rode the fused
+    # Pallas kernel (ops.pallas_quant) — ledger_report splits MFU on it.
     "step": ("step", "loss", "throughput", "unit",
              "data_s", "dispatch_s", "device_s", "comm_s", "mfu"),
     # end-of-epoch rollup (the legacy per-epoch CSV row renders from this)

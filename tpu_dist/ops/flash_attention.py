@@ -412,6 +412,153 @@ def _fa_backward(q, k, v, out, lse, g, causal, q_offset, kv_offset,
     return unfold(dq, lq), unfold(dk, lk), unfold(dv, lk)
 
 
+# ---------------------------------------------------------------------------
+# int8-KV flash attention (pre-quantized keys/values, decode-path variant)
+# ---------------------------------------------------------------------------
+#
+# The decode tick is KV-bandwidth-bound once contexts grow: every generated
+# token re-reads the whole cache. Storing K/V as int8 with one fp32 scale
+# per (batch, position, head) row halves that HBM traffic; this kernel
+# consumes the quantized layout DIRECTLY — the dequant multiply happens on
+# the (bk, D) VMEM tile inside the kernel, so the fp16/fp32 K/V never exist
+# in HBM at all. Forward-only by design (decode never differentiates);
+# training keeps the fp kernels above.
+
+def quantize_kv(k, v):
+    """Per-row symmetric int8 quantization of a KV pair in model layout.
+
+    ``k``/``v`` are (B, L, H, D); returns ``(k_q, k_scale, v_q, v_scale)``
+    with int8 values and one fp32 scale per (b, l, h) row (amax over D) —
+    the layout :func:`int8kv_flash_attention_fn` consumes, and the HBM
+    format an int8 KV cache would hold. Rows are quantized by
+    ``ops.quant.quantize_int8`` itself (not a copy of its math), so the
+    round/clip/EPS convention can never drift from the training path's."""
+    from tpu_dist.ops.quant import quantize_int8
+
+    def one(x):
+        q, scale = quantize_int8(x, (-1,))
+        return q, scale[..., 0].astype(jnp.float32)
+    kq, ks = one(k)
+    vq, vs = one(v)
+    return kq, ks, vq, vs
+
+
+def _fa_fwd_int8kv_kernel(q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                          acc_ref, m_ref, l_ref, *,
+                          bq, bk, nk, scale, causal, q_offset, kv_offset):
+    import jax.experimental.pallas as pl
+
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    q_start = q_offset + iq * bq
+    k_start = kv_offset + ik * bk
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    skip, needs_mask = _causal_bounds(causal, q_start, k_start, bq, bk)
+
+    @pl.when(jnp.logical_not(skip))
+    def _step():
+        # dequant on the VMEM tile: int8 rows x per-row fp32 scale — the
+        # only fp copy of this KV block that ever exists
+        kf = k_ref[0].astype(jnp.float32) * ks_ref[0][:, :1]     # (bk, D)
+        vf = v_ref[0].astype(jnp.float32) * vs_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q_ref[0].astype(jnp.float32), kf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale          # (bq, bk)
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(jnp.logical_or(jnp.logical_not(needs_mask),
+                                         kpos <= qpos), s, NEG_INF)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new[:, :1]))
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = (acc_ref[...] * alpha[:, :1]
+                        + jax.lax.dot_general(
+                            p, vf, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+
+    if causal:
+        last_live = jnp.clip((q_start + bq - 1 - kv_offset) // bk, 0, nk - 1)
+    else:
+        last_live = nk - 1
+
+    @pl.when(ik == last_live)
+    def _finalize():
+        l_cur = jnp.maximum(l_ref[..., :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_cur).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def int8kv_flash_attention_fn(block_q: int = 1024, block_k: int | None = None,
+                              interpret: bool | None = None):
+    """Returns ``attn(q, kv, causal=True, q_offset=0, kv_offset=0)`` over a
+    PRE-QUANTIZED KV pack ``kv = quantize_kv(k, v)`` (int8 values + per-row
+    fp32 scales): the decode-path flash variant — K/V stay int8 in HBM,
+    halving the cache traffic the autoregressive tick is bound by, and the
+    dequant happens per VMEM tile inside the kernel. Forward-only (decode
+    never differentiates; the bwd kernels above serve training).
+    ``interpret=None`` auto-selects interpreter mode off-TPU."""
+    if block_k is None:
+        block_k = 1024
+
+    def attn(q, kv, *, causal: bool = True, q_offset=0, kv_offset=0):
+        import jax.experimental.pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        kq, ks, vq, vs = kv
+        use_interpret = (interpret if interpret is not None
+                         else jax.default_backend() != "tpu")
+        b, lq, h, d = q.shape
+        lk = kq.shape[1]
+        bq, bk = _blocks(lq, lk, block_q, block_k)
+        qf = _fold(q)
+        kf, vf = _fold(kq), _fold(vq)                # (B*H, L, D) int8
+        # scales to the lse/delta stat layout: (B*H, L, _STAT_LANES)
+        def fold_scale(s):
+            s2 = jnp.swapaxes(s, 1, 2).reshape(b * h, lk)
+            return jnp.broadcast_to(s2[..., None], (b * h, lk, _STAT_LANES))
+        ksf, vsf = fold_scale(ks), fold_scale(vs)
+        scale = 1.0 / math.sqrt(d)
+        grid = (b * h, lq // bq, lk // bk)
+
+        out = pl.pallas_call(
+            functools.partial(_fa_fwd_int8kv_kernel, bq=bq, bk=bk,
+                              nk=lk // bk, scale=scale, causal=causal,
+                              q_offset=q_offset, kv_offset=kv_offset),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
+                pl.BlockSpec((1, bk, _STAT_LANES),
+                             lambda bh, iq, ik: (bh, ik, 0)),
+                pl.BlockSpec((1, bk, _STAT_LANES),
+                             lambda bh, iq, ik: (bh, ik, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
+            out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),        # acc
+                pltpu.VMEM((bq, _LANES), jnp.float32),   # running max
+                pltpu.VMEM((bq, _LANES), jnp.float32),   # running sum
+            ],
+            interpret=use_interpret,
+        )(qf, kf, vf, ksf, vsf)
+        return jnp.swapaxes(out.reshape(b, h, lq, d), 1, 2)
+
+    return attn
+
+
 @functools.lru_cache(maxsize=None)
 def flash_attention_fn(block_q: int = 1024, block_k: int | None = None,
                        interpret: bool | None = None,

@@ -11,11 +11,18 @@ jitted step too; the honest value is guaranteed fusion + donated buffers,
 and a vehicle for lower-precision moment experiments).
 
 Update rule, exactly optax.adamw (ops.optim.make_optimizer kind='adamw',
-eps_root=0):
+eps_root=0), with optional global-norm clipping fused in:
+    g  <- g * cs           (cs = clip/norm when norm > clip, else 1)
     m' = b1 m + (1-b1) g
     v' = b2 v + (1-b2) g^2
     mhat = m' / (1 - b1^t);  vhat = v' / (1 - b2^t)
     p' = p - lr (mhat / (sqrt(vhat) + eps) + wd p)
+
+``clip_norm > 0`` is optax.clip_by_global_norm semantics (raw grads,
+before the moment statistics) at zero extra passes: the norm is one
+squared-sum reduction per leaf and the scale rides the scalar row into
+the kernel, where the multiply fuses with the moment update — the
+standalone clip pass optax pays disappears.
 
 All math fp32 regardless of param dtype (bf16 params round once at the
 final store) — fp32 master-moment semantics. ``t`` is the 1-indexed step.
@@ -31,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_dist.ops.pallas_sgd import clip_scale
+
 LANE = 128
 BLOCK_ROWS = 512    # 512x128 fp32 = 256 KiB per VMEM buffer
 
@@ -43,8 +52,9 @@ def _adamw_kernel(scal_ref, p_ref, g_ref, m_ref, v_ref, p_out, m_out, v_out):
     wd = scal_ref[0, 4]
     c1 = scal_ref[0, 5]   # 1 - b1^t
     c2 = scal_ref[0, 6]   # 1 - b2^t
+    cs = scal_ref[0, 7]   # global-norm clip scale (1.0 = no clip)
     p = p_ref[:].astype(jnp.float32)
-    g = g_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32) * cs
     m = b1 * m_ref[:].astype(jnp.float32) + (1.0 - b1) * g
     v = b2 * v_ref[:].astype(jnp.float32) + (1.0 - b2) * g * g
     update = (m / c1) / (jnp.sqrt(v / c2) + eps) + wd * p
@@ -77,7 +87,8 @@ def fused_adamw_leaf(p, g, m, v, scalars, interpret=False):
     """Apply the fused update to one array; returns (p', m', v').
 
     ``scalars`` is the shared (1, 8) fp32 row [lr, b1, b2, eps, wd,
-    1-b1^t, 1-b2^t, 0] — built once per step, not per leaf."""
+    1-b1^t, 1-b2^t, clip_scale] — built once per step, not per leaf
+    (clip_scale = 1.0 when clipping is off)."""
     shape, size = p.shape, p.size
     rows = -(-size // LANE)
     pad = rows * LANE - size
@@ -107,10 +118,11 @@ class FusedAdamW:
 
     def __init__(self, schedule: Callable, b1: float = 0.9, b2: float = 0.95,
                  eps: float = 1e-8, weight_decay: float = 0.1,
-                 interpret: bool = False):
+                 clip_norm: float = 0.0, interpret: bool = False):
         self.schedule = schedule
         self.b1, self.b2, self.eps = b1, b2, eps
         self.weight_decay = weight_decay
+        self.clip_norm = clip_norm
         self.interpret = interpret
 
     def init(self, params) -> FusedAdamWState:
@@ -126,7 +138,7 @@ class FusedAdamW:
             jnp.float32(self.eps), jnp.float32(self.weight_decay),
             1.0 - jnp.float32(self.b1) ** t,
             1.0 - jnp.float32(self.b2) ** t,
-            jnp.float32(0)]).reshape(1, 8)
+            clip_scale(grads, self.clip_norm)]).reshape(1, 8)
         out = jax.tree.map(partial(self._leaf, scalars),
                            params, grads, state.mu, state.nu)
         pick = lambda i: jax.tree.map(

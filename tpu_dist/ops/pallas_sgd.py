@@ -9,10 +9,19 @@ multi-pass (XLA usually fuses that chain inside the jitted step too, so the
 honest value here is guaranteed fusion + a vehicle for lower-precision
 momentum experiments; the microbenchmark in tests reports both paths).
 
-Update rule, exactly torch.optim.SGD (reference 1.dataparallel.py:114-116):
+Update rule, exactly torch.optim.SGD (reference 1.dataparallel.py:114-116),
+with optional global-norm clipping fused in:
+    g  <- g * cs           (cs = clip/norm when norm > clip, else 1)
     g' = g + wd * p
     m' = mu * m + g'
     p' = p - lr * m'
+
+``clip_norm > 0`` is torch.nn.utils.clip_grad_norm_ placement (raw grads,
+before weight decay and momentum) at zero extra passes over the params:
+the global norm is one squared-sum reduction per leaf (:func:`clip_scale`)
+and the resulting scale rides the scalar row into the kernel, where the
+multiply fuses with the update sweep — no standalone clip pass ever
+touches HBM. ops.pallas_adamw mirrors the same slot.
 
 All math in fp32 regardless of the param dtype (bf16 params round once, at
 the final store) — matching fp32 master-weight semantics.
@@ -33,12 +42,30 @@ LANE = 128          # VPU lane width
 BLOCK_ROWS = 512    # rows per grid step: 512x128 fp32 = 256 KiB/buffer in VMEM
 
 
+def clip_scale(grads, clip_norm: float):
+    """Global-norm clip factor for a grad tree: ``clip/norm`` when the fp32
+    global norm exceeds ``clip_norm``, else 1.0 (optax.clip_by_global_norm /
+    torch clip_grad_norm_ semantics, the parallel.pp._clip_pp_grads
+    formula). One squared-sum reduction per leaf; the factor then rides the
+    fused kernels' scalar row so the clip multiply costs no extra pass.
+    ``clip_norm <= 0`` returns a constant 1.0 (clipping off)."""
+    if clip_norm <= 0:
+        return jnp.float32(1.0)
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+             for g in jax.tree.leaves(grads))
+    norm = jnp.sqrt(sq)
+    return jnp.where(norm > clip_norm,
+                     jnp.float32(clip_norm) / jnp.maximum(norm, 1e-30),
+                     jnp.float32(1.0))
+
+
 def _sgd_kernel(scal_ref, p_ref, g_ref, m_ref, p_out, m_out):
     lr = scal_ref[0, 0]
     mu = scal_ref[0, 1]
     wd = scal_ref[0, 2]
+    cs = scal_ref[0, 3]   # global-norm clip scale (1.0 = no clip)
     p = p_ref[:].astype(jnp.float32)
-    g = g_ref[:].astype(jnp.float32) + wd * p
+    g = g_ref[:].astype(jnp.float32) * cs + wd * p
     m = mu * m_ref[:].astype(jnp.float32) + g
     p_out[:] = (p - lr * m).astype(p_out.dtype)
     m_out[:] = m
@@ -63,8 +90,12 @@ def _fused_sgd_2d(p2, g2, m2, scalars, interpret: bool):
     )(scalars, p2, g2, m2)
 
 
-def fused_sgd_leaf(p, g, m, lr, momentum, weight_decay, interpret=False):
-    """Apply the fused update to one array (any shape/dtype); returns (p', m')."""
+def fused_sgd_leaf(p, g, m, lr, momentum, weight_decay, interpret=False,
+                   clip=1.0):
+    """Apply the fused update to one array (any shape/dtype); returns
+    (p', m'). ``clip`` is the shared global-norm scale (:func:`clip_scale`;
+    1.0 = clipping off) — computed ONCE per step over the whole tree, not
+    per leaf."""
     shape, size = p.shape, p.size
     rows = -(-size // LANE)
     pad = rows * LANE - size
@@ -76,7 +107,7 @@ def fused_sgd_leaf(p, g, m, lr, momentum, weight_decay, interpret=False):
     scalars = jnp.stack([jnp.asarray(lr, jnp.float32),
                          jnp.asarray(momentum, jnp.float32),
                          jnp.asarray(weight_decay, jnp.float32),
-                         jnp.float32(0)]).reshape(1, 4)
+                         jnp.asarray(clip, jnp.float32)]).reshape(1, 4)
     p2, m2 = _fused_sgd_2d(to2d(p, p.dtype), to2d(g, jnp.float32),
                            to2d(m, jnp.float32), scalars, interpret)
     unpad = lambda x2, dt: x2.reshape(-1)[:size].reshape(shape).astype(dt)
@@ -96,10 +127,12 @@ class FusedSGD:
     """
 
     def __init__(self, schedule: Callable, momentum: float = 0.9,
-                 weight_decay: float = 1e-4, interpret: bool = False):
+                 weight_decay: float = 1e-4, clip_norm: float = 0.0,
+                 interpret: bool = False):
         self.schedule = schedule
         self.momentum = momentum
         self.weight_decay = weight_decay
+        self.clip_norm = clip_norm
         self.interpret = interpret
 
     def init(self, params) -> FusedSGDState:
@@ -108,14 +141,15 @@ class FusedSGD:
 
     def apply(self, params, grads, state: FusedSGDState, step):
         lr = jnp.asarray(self.schedule(step), jnp.float32)
+        cs = clip_scale(grads, self.clip_norm)
         out = jax.tree.map(
-            partial(self._leaf, lr), params, grads, state.trace)
+            partial(self._leaf, lr, cs), params, grads, state.trace)
         new_params = jax.tree.map(lambda o: o[0], out,
                                   is_leaf=lambda x: isinstance(x, tuple))
         new_trace = jax.tree.map(lambda o: o[1], out,
                                  is_leaf=lambda x: isinstance(x, tuple))
         return new_params, FusedSGDState(trace=new_trace)
 
-    def _leaf(self, lr, p, g, m):
+    def _leaf(self, lr, cs, p, g, m):
         return fused_sgd_leaf(p, g, m, lr, self.momentum, self.weight_decay,
-                              interpret=self.interpret)
+                              interpret=self.interpret, clip=cs)

@@ -35,6 +35,7 @@ quantize/amax ops partition like any other elementwise/reduce op.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Optional
 
@@ -46,6 +47,32 @@ QUANT_MODES = ("none", "int8", "int8_wo")
 
 _INT8_MAX = 127.0
 _EPS = 1e-8  # floor for all-zero channels: keeps scale finite, q = 0
+
+# ---- fused-kernel dispatch (ops.pallas_quant) ------------------------------
+# Tri-state: None = auto (fused on TPU, reference math elsewhere — the
+# interpret-mode kernel is correct but slow, so CPU tests keep the cheap
+# XLA path unless they opt in); True/False force it. The env knob
+# TPU_DIST_FUSED_QUANT=1/0 seeds the state so bench/CLI runs can flip it
+# without code. Trace-time static: set it BEFORE building step functions.
+_FUSED_QUANT: Optional[bool] = (
+    None if os.environ.get("TPU_DIST_FUSED_QUANT", "") == ""
+    else os.environ["TPU_DIST_FUSED_QUANT"] not in ("0", "false", ""))
+
+
+def set_fused_quant(enabled: Optional[bool]) -> None:
+    """Force the fused Pallas int8 kernel on/off (None restores auto).
+    Trace-time static — call before step functions are built."""
+    global _FUSED_QUANT
+    _FUSED_QUANT = enabled
+
+
+def fused_quant_active() -> bool:
+    """Whether ``quant_matmul(mode='int8')`` routes through the fused
+    Pallas kernel right now (the engines stamp this into step records as
+    the ``fused`` flag so ledger readers can attribute MFU deltas)."""
+    if _FUSED_QUANT is not None:
+        return _FUSED_QUANT
+    return jax.default_backend() == "tpu"
 
 
 def validate_quant(mode: str) -> str:
@@ -138,6 +165,12 @@ def quant_matmul(x: jax.Array, w: jax.Array, mode: str) -> jax.Array:
     'int8_wo', an exact fp matmul for 'none'. Both operands must already
     be in the compute dtype."""
     if mode == "int8":
+        if fused_quant_active():
+            # one Pallas kernel: quantize + int8 MXU dot + dequant, no
+            # int8/int32 HBM intermediates (ops.pallas_quant); identical
+            # scales/rounding to the reference einsum, STE backward
+            from tpu_dist.ops.pallas_quant import fused_quant_matmul
+            return fused_quant_matmul(x, w)
         # both operands quantized, int32 accumulation, STE backward
         return quant_einsum(_dense_spec(x.ndim), x, w)
     if mode == "int8_wo":

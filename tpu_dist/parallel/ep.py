@@ -61,6 +61,7 @@ def shard_moe_params(mesh: Mesh, params, axis: str = EXPERT_AXIS,
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
                              ep_param_specs(params, axis, model_axis),
                              is_leaf=lambda x: isinstance(x, P))
+    # distlint: disable=DL008 -- param placement at setup/resume, not a per-step input upload
     return jax.device_put(params, shardings)
 
 
@@ -94,6 +95,7 @@ def shard_state_ep(mesh: Mesh, state, axis: str = EXPERT_AXIS,
             if hasattr(leaf, "ndim") else P()
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
+    # distlint: disable=DL008 -- state placement at setup/resume, not a per-step input upload
     return TrainState(
         step=jax.device_put(state.step, repl),
         params=shard_moe_params(mesh, state.params, axis, tp_axis),

@@ -58,6 +58,7 @@ def shard_state_fsdp(mesh: Mesh, state, axis: str = DATA_AXIS,
     from tpu_dist.engine.state import TrainState
 
     repl = NamedSharding(mesh, P())
+    # distlint: disable=DL008 -- state placement at setup/resume, not a per-step input upload
     return TrainState(
         step=jax.device_put(state.step, repl),
         params=jax.device_put(state.params,

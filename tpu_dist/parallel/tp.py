@@ -58,4 +58,5 @@ def lm_param_shardings(mesh: Mesh, params) -> Any:
 
 def shard_lm_params(mesh: Mesh, params):
     """device_put params onto their TP shardings."""
+    # distlint: disable=DL008 -- param placement at setup/resume, not a per-step input upload
     return jax.device_put(params, lm_param_shardings(mesh, params))
