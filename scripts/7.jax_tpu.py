@@ -39,5 +39,13 @@ if __name__ == "__main__":
                          for f in dataclasses.fields(TrainConfig)})
     info = launch.initialize()
     print(f"[proc {info.process_id}/{info.num_processes}] via {info.method}")
-    best = Trainer(cfg).fit()
+    if cfg.max_restarts > 0:
+        # in-process self-healing (parallel.supervisor): HealthError halts
+        # and organic crashes rebuild the trainer with attempt lineage and
+        # resume from the newest valid checkpoint. Process-killing faults
+        # need the subprocess flavor: python -m tpu_dist.supervise -- ...
+        from tpu_dist.parallel.supervisor import run_supervised
+        best = run_supervised(Trainer, cfg)
+    else:
+        best = Trainer(cfg).fit()
     print(f"best_acc1 {best * 100:.3f}")

@@ -80,7 +80,32 @@ def main():
               f"mesh={dict(trainer.mesh.shape)} mode={trainer.mode} "
               f"corpus={trainer.train_ds.name} rows={len(trainer.train_ds)} "
               f"tokens/step={cfg.batch_size * cfg.seq_len}")
-    best_ppl = trainer.fit()
+    if cfg.max_restarts > 0:
+        # in-process self-healing (parallel.supervisor): halts/crashes
+        # rebuild the trainer with attempt lineage + newest-valid resume.
+        # The prebuilt trainer serves attempt 0 (avoids a second compile);
+        # restarts rebuild, and the --generate path below must decode the
+        # LAST attempt's state, so the factory tracks it. Process-killing
+        # faults need the subprocess flavor:
+        # python -m tpu_dist.supervise -- python scripts/8...
+        from tpu_dist.parallel.supervisor import run_supervised
+        current = {"trainer": trainer, "used": False}
+
+        def build(run_cfg):
+            if current["used"]:
+                # drop the dead attempt's trainer BEFORE constructing the
+                # replacement: its params/opt-state must be collectable
+                # while the rebuild re-allocates them (HBM headroom)
+                current["trainer"] = None
+                current["trainer"] = LMTrainer(run_cfg)
+            current["used"] = True  # one-shot: attempt 0 and ONLY attempt
+            # 0 gets the prebuilt trainer, even when it died pre-step
+            return current["trainer"]
+
+        best_ppl = run_supervised(build, cfg)
+        trainer = current["trainer"]
+    else:
+        best_ppl = trainer.fit()
     if jax.process_index() == 0 and not cfg.evaluate:
         print(f"throughput {trainer.last_tok_s:,.0f} tokens/sec "
               f"({trainer.mode}) best_ppl {best_ppl:.2f}")

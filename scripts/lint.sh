@@ -20,6 +20,45 @@ python -m tools.distlint --sarif-out distlint.sarif --with-debt "$@"
 # trailing best — the apex-data_prefetcher class of silent regression.
 python tools/bench_track.py --check
 
+# Supervisor-policy gate (round 10), jax-free BY CONSTRUCTION: the elastic
+# supervisor must keep working on a bare login/CI host (no jax installed),
+# so this pass hard-blocks jax imports and runs the restart classification,
+# backoff math, degraded-shrink and fault-spec grammar as units. A stray
+# `import jax` creeping into parallel.supervisor / obs.faults / the lazy
+# parallel __init__ fails HERE, before any pod notices.
+python - <<'EOF'
+import builtins, signal
+
+_real = builtins.__import__
+def _guard(name, *a, **k):
+    if name == "jax" or name.startswith("jax."):
+        raise ImportError(f"supervisor policy gate: jax import blocked ({name})")
+    return _real(name, *a, **k)
+builtins.__import__ = _guard
+
+from tpu_dist.obs.faults import FaultPlan
+from tpu_dist.parallel.supervisor import (RestartPolicy, classify_attempt,
+                                          compute_backoff, degraded_env)
+from tpu_dist.supervise import build_parser
+
+pol = RestartPolicy(backoff_base_s=1.0, backoff_max_s=8.0)
+assert [compute_backoff(n, pol) for n in (0, 1, 2, 3, 9)] == \
+    [0.0, 1.0, 2.0, 4.0, 8.0]
+end = {"event": "run_end", "status": "crashed",
+       "error": "HealthError: val_loss spike"}
+assert classify_attempt([end], 1) == "health_halt"
+assert classify_attempt([], -signal.SIGTERM) == "preemption"
+assert classify_attempt([], 1, stderr_tail="rendezvous failed") == "rendezvous"
+assert classify_attempt([{"event": "stall"}], -9, True) == "stall"
+assert classify_attempt([], 13) == "crash"
+env, n = degraded_env({"TPU_DIST_NUM_PROCESSES": "4"})
+assert n == 3 and env["TPU_DIST_DEGRADED"] == "1"
+plan = FaultPlan.parse("hard_exit@step=10,attempt=0;rendezvous_fail@times=2")
+assert plan.sites() == {"hard_exit", "rendezvous_fail"}
+build_parser().parse_args(["--ledger", "x.jsonl", "--", "true"])
+print("supervisor policy gate: OK (no jax)")
+EOF
+
 # Advisory tier-1 budget creep warning (never fails the gate): conftest
 # writes each full-suite run's wall time + top-20 durations to
 # /tmp/tier1_durations.json (TPU_DIST_TIER1_DURATIONS overrides); the

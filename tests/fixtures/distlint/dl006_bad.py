@@ -6,3 +6,8 @@ def emit_badly(ledger, name, fields):
     ledger.emit(name, step=1)                  # computed event name
     ledger.emit("step", **fields)              # required fields in a splat
     ledger.emit()                              # no event at all
+
+
+def emit_fault_badly(led):
+    # round 10: the fault-injection event is schema-checked like the rest
+    led.emit("fault", spec="hard_exit@step=3")  # missing site + step

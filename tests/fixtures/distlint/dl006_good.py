@@ -10,3 +10,9 @@ def emit_well(ledger, extra):
 def forward_wrapper(led, event, fields):
     # declared forwarding wrapper: re-exposes emit()'s own signature
     return led.emit(event, **fields)  # ledger-schema: forward
+
+
+def emit_fault_well(led):
+    # round 10: obs.faults' injection record (site/step/spec required)
+    led.emit("fault", site="hard_exit", step=3,
+             spec="hard_exit@step=3,attempt=0", attempt=0)

@@ -33,7 +33,12 @@ def test_lenet_forward_shape():
     assert out.shape == (4, 10)
 
 
-@pytest.mark.parametrize("arch", ["resnet18", "resnet50"])
+# tier-1 budget (PR 10): resnet50's bottleneck-block compile is a 14s
+# near-duplicate of the resnet18 basic-block forward; resnet18 stays the
+# family's live compile, and resnet50's plan structure stays pinned by the
+# eval_shape param-count test (no compile)
+@pytest.mark.parametrize("arch", [
+    "resnet18", pytest.param("resnet50", marks=pytest.mark.slow)])
 def test_resnet_forward_shape(arch):
     m = create_model(arch, num_classes=10)
     x = jnp.zeros((2, 32, 32, 3))
@@ -72,8 +77,12 @@ _HEAVY_ZOO = pytest.mark.slow
     pytest.param("mobilenet_v2", marks=_HEAVY_ZOO),
     # tier-1 budget (PR 7): the x1_0/1_1 flavors are 12-14s compiles each;
     # the 0_5/1_0 siblings keep a cheap live representative per family
-    # (plan structure stays pinned via the eval_shape param-count tests)
-    pytest.param("squeezenet1_1", marks=_HEAVY_ZOO), "squeezenet1_0",
+    # (plan structure stays pinned via the eval_shape param-count tests).
+    # PR 10 measurement: squeezenet1_0 compiles in 12s too — both flavors
+    # slow-marked; alexnet/vgg11 stay the zoo's live compiles and the
+    # eval_shape param test still pins both squeezenet plans
+    pytest.param("squeezenet1_1", marks=_HEAVY_ZOO),
+    pytest.param("squeezenet1_0", marks=_HEAVY_ZOO),
     pytest.param("shufflenet_v2_x1_0", marks=_HEAVY_ZOO),
     "shufflenet_v2_x0_5",
     pytest.param("efficientnet_b0", marks=_HEAVY_ZOO),
@@ -149,7 +158,12 @@ def test_inception_v3_forward_96px():
     assert "batch_stats" in v
 
 
-@pytest.mark.parametrize("arch", ["resnext50_32x4d", "wide_resnet50_2"])
+# tier-1 budget (PR 10): the two bottleneck variants are ~9s compiles each
+# and near-duplicates of one another; the widened plan stays live, the
+# grouped one keeps its exact param-count pin
+@pytest.mark.parametrize("arch", [
+    pytest.param("resnext50_32x4d", marks=pytest.mark.slow),
+    "wide_resnet50_2"])
 def test_resnet_variant_forward_shape(arch):
     """Grouped (ResNeXt) and widened (WideResNet) bottleneck plans."""
     m = create_model(arch, num_classes=10)

@@ -212,6 +212,57 @@ def goodput_section(records, out=print):
     return gp
 
 
+def restarts_section(records, out=print, crash_loop_k=3):
+    """The remediation view of a stitched multi-attempt job: per-attempt
+    failure classification (parallel.supervisor.classify_attempt over
+    ``run_end`` status + ``fault``/``stall`` evidence), injected-vs-organic
+    fault counts, and a crash-loop banner when the trailing
+    ``crash_loop_k`` attempts all died before their first step. Rendered
+    only when there is something to say (restarts or injections)."""
+    from tpu_dist.obs.goodput import split_attempts
+    from tpu_dist.parallel.supervisor import classify_attempt
+
+    fault_events = [r for r in records if r["event"] == "fault"]
+    attempts = split_attempts(records)
+    if len(attempts) <= 1 and not fault_events:
+        return None
+    rows = []
+    for recs in attempts:
+        starts = [r for r in recs if r.get("event") == "run_start"]
+        ordinal = (starts[0].get("attempt")
+                   if starts and starts[0].get("attempt") is not None
+                   else len(rows))
+        rows.append({
+            "attempt": ordinal,
+            "class": classify_attempt(recs),
+            "steps": sum(1 for r in recs if r.get("event") == "step"),
+            "injected": [str(r.get("site") or "?") for r in recs
+                         if r.get("event") == "fault"]})
+    organic = sum(1 for r in rows
+                  if r["class"] != "clean" and not r["injected"])
+    out(f"\nrestarts ({len(rows)} attempt(s), "
+        f"{len(rows) - 1} restart(s)):")
+    for r in rows:
+        out(f"  attempt {r['attempt']}: {r['class']}, "
+            f"{r['steps']} step record(s)"
+            + (f"; injected fault(s): {', '.join(r['injected'])}"
+               if r["injected"] else ""))
+    trailing_dead = 0
+    for r in reversed(rows):
+        if r["steps"] or r["class"] == "clean":
+            break
+        trailing_dead += 1
+    crash_loop = trailing_dead >= crash_loop_k
+    if crash_loop:
+        out(f"  CRASH LOOP: the last {trailing_dead} attempts died before "
+            "their first step — the failure is deterministic; fix the run "
+            "instead of restarting it")
+    out(f"  faults: {len(fault_events)} injected (obs.faults), "
+        f"{organic} organic failure(s)")
+    return {"attempts": rows, "injected_faults": len(fault_events),
+            "organic_failures": organic, "crash_loop": crash_loop}
+
+
 def decode_section(records, out=print):
     """The serving-SLO section: per-request latency percentiles and tok/s
     over the `decode` events (engine.generate / tools/decode_bench)."""
@@ -277,6 +328,9 @@ def summarize(records, out=print):
 
     # wall-clock accounting (obs.goodput) — attempts stitched, gaps charged
     summary["goodput"] = goodput_section(records, out=out)
+    # remediation view (parallel.supervisor lineage): per-attempt failure
+    # classes, injected-vs-organic faults, crash-loop banner
+    summary["restarts"] = restarts_section(records, out=out)
 
     if steps:
         # warm records carry the XLA compile in dispatch_s; exclude them

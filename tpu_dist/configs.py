@@ -175,6 +175,27 @@ class TrainConfig:
                                        # (img/s here, tok/s in LMConfig;
                                        # 0 = off)
 
+    # -- self-healing (round 10: parallel.supervisor + obs.faults)
+    faults: str = ""                   # deterministic fault-injection spec
+                                       # (obs.faults grammar, e.g.
+                                       # "hard_exit@step=10,attempt=0";
+                                       # TPU_DIST_FAULTS env also honored)
+    keep_checkpoints: int = 3          # retain the last K checkpoints as
+                                       # step-stamped hard links + a
+                                       # newest-valid pointer; a corrupt
+                                       # newest falls back at load (0 =
+                                       # newest only, pre-round-10)
+    max_restarts: int = 0              # >0: wrap fit() in the in-process
+                                       # supervised-restart loop
+                                       # (parallel.supervisor.
+                                       # run_supervised); halts/crashes
+                                       # resume from the newest valid
+                                       # checkpoint with attempt lineage
+    restart_backoff_s: float = 1.0     # restart backoff base (doubles per
+                                       # restart, capped at 60s)
+    crash_loop_k: int = 3              # stop restarting after K
+                                       # consecutive pre-first-step deaths
+
     # -- synthetic-data knobs (TPU-only: zero-egress envs can't download datasets)
     synth_train_size: int = 50000
     synth_val_size: int = 10000
@@ -333,6 +354,15 @@ class LMConfig:
                                    # 'slo' -> flight-recorder bundle)
     slo_throughput: float = 0.0    # progress-SLO floor on EMA tok/s
                                    # (0 = off)
+    faults: str = ""               # fault-injection spec (obs.faults;
+                                   # TPU_DIST_FAULTS env also honored)
+    keep_checkpoints: int = 3      # keep-last-K retention + newest-valid
+                                   # pointer (corrupt newest falls back)
+    max_restarts: int = 0          # >0: in-process supervised restarts
+                                   # (parallel.supervisor.run_supervised)
+    restart_backoff_s: float = 1.0 # restart backoff base (doubles, cap 60s)
+    crash_loop_k: int = 3          # crash-loop cutoff: K consecutive
+                                   # pre-first-step deaths stop the loop
 
 
 def add_args(parser: argparse.ArgumentParser, defaults) -> None:
@@ -348,8 +378,14 @@ def add_args(parser: argparse.ArgumentParser, defaults) -> None:
             parser.add_argument(name, action=argparse.BooleanOptionalAction,
                                 default=default)
         elif f.name == "mesh_shape":
-            parser.add_argument(name, type=lambda s: tuple(int(x) for x in s.split(",")),
-                                default=default)
+            # "" -> None (auto: all devices on the data axis) — the
+            # supervisor's degraded relaunch uses --mesh-shape "" to reset
+            # an explicit layout after mesh shrink
+            parser.add_argument(
+                name,
+                type=lambda s: tuple(int(x) for x in s.split(",")) if s
+                else None,
+                default=default)
         elif f.name == "mesh_axes":
             parser.add_argument(name, type=lambda s: tuple(s.split(",")), default=default)
         else:

@@ -17,18 +17,28 @@ tpu_dist does what the reference should have done:
 
 from __future__ import annotations
 
+import errno
+import glob
 import json
 import os
+import re
 import shutil
+import sys
 import threading
-from typing import Any, Dict, Optional, Tuple
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 from flax import serialization
 
-_async_writer: Optional[threading.Thread] = None
-_async_error: Optional[BaseException] = None
+from tpu_dist.obs import faults as _faults
+
+
+class CheckpointCorruptError(ValueError):
+    """The named checkpoint AND every retained fallback failed integrity
+    checks (CRC32/length from the container header) — nothing valid to
+    resume from."""
 
 
 def gather_to_host(tree):
@@ -59,7 +69,9 @@ _to_host = gather_to_host  # internal alias
 
 # single-file container so blob+meta commit in ONE os.replace (a two-file
 # scheme always has a crash window that pairs a new blob with old meta):
-# MAGIC | u64-le meta_len | meta json | msgpack blob
+# MAGIC | u64-le meta_len | meta json | msgpack blob. Since round 10 the
+# meta carries blob_len + crc32 of the blob, so a truncated or bit-rotted
+# file is DETECTABLE at load (and load falls back to a retained sibling)
 _MAGIC = b"TPUDIST1\n"
 
 
@@ -75,10 +87,92 @@ def _split_container(raw: bytes) -> Tuple[Dict, Any]:
     return meta, memoryview(raw)[off + 8 + meta_len:]
 
 
+def _integrity_error(meta: Dict, blob) -> Optional[str]:
+    """Why this container fails its own header's integrity stamps (None =
+    intact, or a pre-crc file with nothing to check)."""
+    want_len = meta.get("blob_len")
+    if want_len is not None and len(blob) != int(want_len):
+        return (f"blob is {len(blob)} bytes, header says {want_len} "
+                "(truncated write?)")
+    want_crc = meta.get("crc32")
+    if want_crc is not None:
+        got = zlib.crc32(blob) & 0xFFFFFFFF
+        if got != int(want_crc):
+            return f"CRC32 mismatch (header {want_crc:#010x}, file {got:#010x})"
+    return None
+
+
+def _retained_path(path: str, step: int) -> str:
+    root, ext = os.path.splitext(path)
+    return f"{root}.r{int(step)}{ext}"
+
+
+def retained_checkpoints(path: str) -> List[str]:
+    """The keep-last-K retained siblings of a checkpoint path, newest
+    (highest step) first — the fallback order for a corrupt newest."""
+    root, ext = os.path.splitext(path)
+    found = []
+    for p in glob.glob(f"{glob.escape(root)}.r*{ext}"):
+        m = re.fullmatch(re.escape(root) + r"\.r(\d+)" + re.escape(ext), p)
+        if m:
+            found.append((int(m.group(1)), p))
+    return [p for _, p in sorted(found, reverse=True)]
+
+
+def _retain(ckpt_dir: str, path: str, meta: Dict, keep: int,
+            is_best: bool) -> None:
+    """Keep-last-K retention + the newest-valid pointer file. Hard links
+    where the FS allows (zero extra bytes), copies otherwise. Runs AFTER
+    the atomic replace of ``path`` — a crash here loses at most history,
+    never the newest checkpoint."""
+    retained = []
+    if keep > 0:
+        snap = _retained_path(path, meta.get("step", 0))
+        try:
+            if os.path.exists(snap):
+                os.remove(snap)
+            try:
+                os.link(path, snap)
+            except OSError:  # FS without hard links
+                shutil.copyfile(path, snap)
+        except OSError as e:
+            print(f"warning: checkpoint retention copy failed: {e}",
+                  file=sys.stderr)
+        retained = retained_checkpoints(path)
+        for stale in retained[keep:]:
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+        retained = retained[:keep]
+    # the pointer: written only after a fully-committed container, so it
+    # always names the newest VALID checkpoint (an ENOSPC'd write never
+    # advances it) — parallel.supervisor resumes from this
+    root, _ = os.path.splitext(path)
+    index = {"newest": os.path.basename(path),
+             "step": meta.get("step"), "epoch": meta.get("epoch"),
+             "crc32": meta.get("crc32"),
+             "retained": [os.path.basename(p) for p in retained],
+             "best": (f"{meta.get('arch')}-model_best.msgpack"
+                      if is_best else None)}
+    tmp = root + ".index.json.tmp"
+    with open(tmp, "w") as f:
+        json.dump(index, f)
+    os.replace(tmp, root + ".index.json")
+
+
 def _write(ckpt_dir: str, path: str, host_state, meta: Dict,
-           arch: str, is_best: bool) -> None:
-    meta_bytes = json.dumps(meta).encode()
+           arch: str, is_best: bool, keep: int = 0) -> None:
+    fault = _faults.fire("ckpt_enospc")
+    if fault is not None:
+        # before any byte lands: the checkpoint on disk stays the previous
+        # valid one, which is exactly what the fallback path must find
+        raise OSError(errno.ENOSPC,
+                      f"No space left on device (injected: {fault.spec})")
     blob = serialization.to_bytes(host_state)
+    meta = dict(meta, blob_len=len(blob),
+                crc32=zlib.crc32(blob) & 0xFFFFFFFF)
+    meta_bytes = json.dumps(meta).encode()
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(_MAGIC)
@@ -91,6 +185,7 @@ def _write(ckpt_dir: str, path: str, host_state, meta: Dict,
     with open(path + ".json.tmp", "w") as f:
         json.dump(meta, f)
     os.replace(path + ".json.tmp", path + ".json")
+    _retain(ckpt_dir, path, meta, keep, is_best)
     if is_best:
         # reference shutil.copyfile to 'model_best' (1.dataparallel.py:287-288),
         # made atomic so a crash mid-copy can't destroy the previous best
@@ -101,19 +196,55 @@ def _write(ckpt_dir: str, path: str, host_state, meta: Dict,
             os.replace(best + ".tmp", best)
 
 
-def wait_for_async_save() -> None:
-    """Block until a pending async write finishes (call before exit/load).
+# -- async writer state, PER ckpt_dir ---------------------------------------
+# One registry entry per checkpoint directory: module-level singleton state
+# (rounds 6-9) serialized ALL checkpoint streams behind one thread and let
+# concurrent dirs race each other's error slot. Distinct dirs now overlap
+# freely; within one dir, writes still serialize (atomic tmp+rename only
+# protects readers, not two writers interleaving history/retention).
+
+class _AsyncWriter:
+    __slots__ = ("thread", "error")
+
+    def __init__(self):
+        self.thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+
+
+_writers: Dict[str, _AsyncWriter] = {}
+_writers_lock = threading.Lock()
+
+
+def _writer_for(ckpt_dir: str) -> _AsyncWriter:
+    key = os.path.abspath(ckpt_dir or ".")
+    with _writers_lock:
+        return _writers.setdefault(key, _AsyncWriter())
+
+
+def wait_for_async_save(ckpt_dir: Optional[str] = None) -> None:
+    """Block until pending async writes finish (``None`` = every dir —
+    the exit-path call; a dir joins only its own stream, so concurrent
+    checkpoint streams never serialize behind each other).
 
     Re-raises any exception the background writer hit (ENOSPC, permissions)
     — write failures must stop the run, not rot checkpoints silently.
     """
-    global _async_writer, _async_error
-    if _async_writer is not None:
-        _async_writer.join()
-        _async_writer = None
-    if _async_error is not None:
-        err, _async_error = _async_error, None
-        raise RuntimeError("async checkpoint write failed") from err
+    with _writers_lock:
+        if ckpt_dir is None:
+            pending = list(_writers.values())
+        else:
+            w = _writers.get(os.path.abspath(ckpt_dir))
+            pending = [w] if w is not None else []
+    first_err = None
+    for w in pending:
+        if w.thread is not None:
+            w.thread.join()
+            w.thread = None
+        if w.error is not None:
+            first_err = first_err or w.error
+            w.error = None
+    if first_err is not None:
+        raise RuntimeError("async checkpoint write failed") from first_err
 
 
 # a process must never exit with a write in flight (daemon threads are
@@ -126,7 +257,7 @@ atexit.register(wait_for_async_save)
 def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
                     arch: str, is_best: bool,
                     extra_meta: Optional[Dict] = None,
-                    async_write: bool = False) -> Optional[str]:
+                    async_write: bool = False, keep: int = 0) -> Optional[str]:
     """Atomic save; returns path on process 0, None elsewhere.
 
     For states with cross-host SHARDED leaves, ALL processes must call this
@@ -134,12 +265,20 @@ def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
 
     ``async_write=True`` moves serialization + disk I/O to a background
     thread (the device->host gather stays synchronous — it must read the
-    state before training mutates it). At most one writer is in flight;
-    a second save joins the previous one first, and atomic tmp+rename means
-    a crash mid-write never corrupts the last complete checkpoint. NOTE:
+    state before training mutates it). At most one writer per ``ckpt_dir``
+    is in flight; a second save to the SAME dir joins the previous one
+    first (distinct dirs overlap freely), and atomic tmp+rename means a
+    crash mid-write never corrupts the last complete checkpoint. NOTE:
     the returned path is not valid to read until
     :func:`wait_for_async_save` returns (which also re-raises writer
     errors; an atexit hook joins any writer left pending at exit).
+
+    ``keep > 0`` additionally retains the last ``keep`` checkpoints as
+    step-stamped hard links (``{arch}-checkpoint.r<step>.msgpack``) and
+    writes a ``{arch}-checkpoint.index.json`` pointer to the newest valid
+    container — the fallback set :func:`load_checkpoint` walks when the
+    newest file fails its CRC, and what ``parallel.supervisor`` resumes
+    from.
     """
     needs_collective = any(
         isinstance(x, jax.Array) and not x.is_fully_addressable
@@ -153,19 +292,20 @@ def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
     path = os.path.join(ckpt_dir, f"{arch}-checkpoint.msgpack")
     meta = {"epoch": epoch, "arch": arch, "best_acc1": float(best_acc1),
             "step": int(host_state.step), **(extra_meta or {})}
-    global _async_writer
-    wait_for_async_save()  # serialize writers, surface prior write errors
+    writer = _writer_for(ckpt_dir)
+    wait_for_async_save(ckpt_dir)  # serialize THIS dir's writers, surface
+    # its prior write errors (other dirs' streams are untouched)
     if async_write:
         def run():
-            global _async_error
             try:
-                _write(ckpt_dir, path, host_state, meta, arch, is_best)
+                _write(ckpt_dir, path, host_state, meta, arch, is_best,
+                       keep=keep)
             except BaseException as e:  # re-raised by wait_for_async_save
-                _async_error = e
-        _async_writer = threading.Thread(target=run, daemon=True)
-        _async_writer.start()
+                writer.error = e
+        writer.thread = threading.Thread(target=run, daemon=True)
+        writer.thread.start()
     else:
-        _write(ckpt_dir, path, host_state, meta, arch, is_best)
+        _write(ckpt_dir, path, host_state, meta, arch, is_best, keep=keep)
     return path
 
 
@@ -228,8 +368,13 @@ def graft_params(fresh, loaded, cast_dtype: bool = True):
     return traverse_util.unflatten_dict(out), n, skipped
 
 
-def load_checkpoint(path: str, template_state) -> Tuple[Any, Dict]:
-    """Restore a TrainState saved by save_checkpoint into template's structure."""
+def _load_one(path: str, template_state) -> Tuple[Any, Dict]:
+    """Restore ONE container file, integrity-checked. Raises
+    CheckpointCorruptError for a truncated/bit-rotted container (the
+    header's own crc32/blob_len disagree with the bytes — the fallback-
+    eligible failure) and ValueError for a structure mismatch (a crc-valid
+    blob that does not fit the template: wrong geometry or optimizer
+    flags — falling back would silently resume an incompatible run)."""
     with open(path, "rb") as f:
         raw = f.read()
     meta, blob = _split_container(raw)
@@ -237,6 +382,9 @@ def load_checkpoint(path: str, template_state) -> Tuple[Any, Dict]:
         # pre-container checkpoint: bare msgpack + sidecar json
         with open(path + ".json") as f:
             meta = json.load(f)
+    bad = _integrity_error(meta, blob)
+    if bad:
+        raise CheckpointCorruptError(f"checkpoint {path!r} is corrupt: {bad}")
     try:
         state = serialization.from_bytes(template_state, blob)
     except (ValueError, KeyError) as e:
@@ -253,3 +401,35 @@ def load_checkpoint(path: str, template_state) -> Tuple[Any, Dict]:
             "removes clip state; --optimizer; --weight-decay 0<->nonzero). "
             f"Original error: {e}") from e
     return state, meta
+
+
+def load_checkpoint(path: str, template_state,
+                    fallback: bool = True) -> Tuple[Any, Dict]:
+    """Restore a TrainState saved by save_checkpoint into template's
+    structure. When the named file fails its container integrity check
+    (crc32/blob_len — a write torn by the very crash being recovered
+    from), ``fallback=True`` walks the retained keep-last-K siblings
+    newest-first and loads the first intact one, with a loud warning —
+    losing a few steps beats losing the run. Structure mismatches never
+    fall back (every retained sibling shares the structure; the error is
+    the caller's flags, not the file)."""
+    candidates = [path] + (retained_checkpoints(path) if fallback else [])
+    last_err: Optional[Exception] = None
+    for i, p in enumerate(candidates):
+        try:
+            state, meta = _load_one(p, template_state)
+        except (CheckpointCorruptError, FileNotFoundError) as e:
+            print(f"warning: {e}"
+                  + ("; falling back to the previous retained checkpoint"
+                     if i + 1 < len(candidates) else ""), file=sys.stderr)
+            last_err = e
+            continue
+        if i > 0:
+            print(f"warning: resumed from RETAINED checkpoint {p!r} "
+                  f"(step {meta.get('step')}) — the newest container was "
+                  "corrupt; steps after it are lost and will be retrained",
+                  file=sys.stderr)
+        return state, meta
+    raise CheckpointCorruptError(
+        f"checkpoint {path!r} is corrupt and no intact retained fallback "
+        f"exists ({len(candidates) - 1} sibling(s) tried)") from last_err

@@ -41,7 +41,8 @@ from tpu_dist.engine.lm_steps import (LM_METRIC_KEYS, make_lm_batches,
                                       make_lm_sp_train_step,
                                       make_lm_train_step)
 from tpu_dist.engine.state import TrainState
-from tpu_dist.obs import RunObs, profile_session, step_annotation
+from tpu_dist.obs import (HealthError, RunObs, faults, profile_session,
+                          step_annotation)
 from tpu_dist.ops import lm_lr_schedule, make_optimizer, make_policy
 from tpu_dist.parallel.mesh import make_mesh, replicated
 from tpu_dist.utils.meters import MeterBank
@@ -737,6 +738,8 @@ class LMTrainer:
             data_s = time.time() - end
             meters.update("Data", data_s)
             gstep = epoch * self.steps_per_epoch + i
+            if "nan_batch" in self.obs.fire_step_faults(gstep):
+                self._apply_nan_fault()
             was_cold = not self._warmed  # this dispatch carries the compile
             with step_annotation(gstep, self.obs.profiling), \
                     tr.span("dispatch"):
@@ -841,6 +844,9 @@ class LMTrainer:
         for n, idx_dev in windows:
             data_s = time.time() - end
             meters.update("Data", data_s / n, n)
+            if "nan_batch" in self.obs.fire_step_faults(
+                    epoch * self.steps_per_epoch + done):
+                self._apply_nan_fault()
             was_cold = not self._warmed  # this dispatch carries the compile
             with step_annotation(epoch * self.steps_per_epoch + done,
                                  self.obs.profiling), tr.span("dispatch"):
@@ -905,6 +911,14 @@ class LMTrainer:
     def _step_cap_hit(self, epoch: int, batches_done: int) -> bool:
         cap = self.cfg.max_steps
         return bool(cap) and epoch * self.steps_per_epoch + batches_done >= cap
+
+    def _apply_nan_fault(self) -> None:
+        """The ``nan_batch`` injection effect (obs.faults): token inputs
+        are integers, so the numeric fault lands on the param tree — the
+        next step's loss/grads go non-finite exactly as a NaN batch would
+        make them, and the health sentry/policy takes it from there."""
+        self.state = self.state.replace(
+            params=faults.poison_params(self.state.params))
 
     # ------------------------------------------------------------------
     def validate(self, epoch: int = 0):
@@ -1016,6 +1030,16 @@ class LMTrainer:
             # flushes it even on OOM/interrupt
             with profile_session(cfg.profile_dir, self.obs.profiling):
                 self._fit_epochs()
+        except HealthError:
+            # a halt must never abandon an in-flight async write: join this
+            # dir's writer before re-raising, surfacing any write failure
+            # as a warning rather than masking the halt itself
+            try:
+                ckpt.wait_for_async_save(cfg.checkpoint_dir or None)
+            except RuntimeError as we:
+                self.log(f"warning: async checkpoint write failed during "
+                         f"health halt: {we}")
+            raise
         except KeyboardInterrupt:
             self.obs.pause()  # slow interrupt-save is not a stall
             if cfg.checkpoint_dir:
@@ -1024,7 +1048,8 @@ class LMTrainer:
                                      0.0, "lm", is_best=False,
                                      extra_meta={"mid_epoch": True,
                                                  "best_ppl": self.best_ppl,
-                                                 **self._run_meta})
+                                                 **self._run_meta},
+                                     keep=cfg.keep_checkpoints)
                 self.log(f"interrupted — checkpoint saved at epoch "
                          f"{self._epoch_in_progress}; resume with --resume")
             else:
@@ -1079,7 +1104,7 @@ class LMTrainer:
                     cfg.checkpoint_dir, self.state, epoch + 1, 0.0, "lm",
                     is_best, extra_meta={"best_ppl": self.best_ppl,
                                          **self._run_meta},
-                    async_write=True)
+                    async_write=True, keep=cfg.keep_checkpoints)
                 self.obs.ledger.emit(
                     "ckpt", epoch=epoch + 1, path=cfg.checkpoint_dir,
                     is_best=is_best,

@@ -35,7 +35,8 @@ from tpu_dist.engine.steps import (make_eval_step, make_indexed_multi_train_step
                                    make_multi_train_step,
                                    make_shard_map_train_step, make_train_step)
 from tpu_dist.models import create_model
-from tpu_dist.obs import RunObs, profile_session, step_annotation
+from tpu_dist.obs import (HealthError, RunObs, faults, profile_session,
+                          step_annotation)
 from tpu_dist.ops import LossScaleState, make_optimizer, make_policy, step_decay_schedule
 from tpu_dist.parallel.mesh import batch_sharding, make_mesh, replicated
 from tpu_dist.utils.meters import MeterBank
@@ -483,6 +484,14 @@ class Trainer:
         pending.clear()
         self.obs.heartbeat()  # watchdog: device progress proven at this sync
 
+    def _apply_nan_fault(self) -> None:
+        """The ``nan_batch`` injection effect (obs.faults): pixel inputs
+        are uint8, so the numeric fault lands on the param tree — the next
+        step's loss/grads go non-finite exactly as a NaN batch would make
+        them, and the health sentry/policy takes it from there."""
+        self.state = self.state.replace(
+            params=faults.poison_params(self.state.params))
+
     # ------------------------------------------------------------------
     def train_epoch(self, epoch: int) -> Dict[str, float]:
         if self.k > 1 or self.device_data:
@@ -521,6 +530,8 @@ class Trainer:
             data_s = time.time() - end
             meters.update("Data", data_s)
             gstep = epoch * self.steps_per_epoch + i
+            if "nan_batch" in self.obs.fire_step_faults(gstep):
+                self._apply_nan_fault()
             was_cold = self._program_hbm is None  # this dispatch compiles
             with step_annotation(gstep, self.obs.profiling), \
                     tr.span("dispatch"):
@@ -669,6 +680,9 @@ class Trainer:
             # avg(Time) = wall / batches in both paths
             data_s = time.time() - end
             meters.update("Data", data_s / n, n)
+            if "nan_batch" in self.obs.fire_step_faults(
+                    epoch * self.steps_per_epoch + done):
+                self._apply_nan_fault()
             was_cold = self._program_hbm is None  # this dispatch compiles
             with step_annotation(epoch * self.steps_per_epoch + done,
                                  self.obs.profiling), tr.span("dispatch"):
@@ -788,6 +802,16 @@ class Trainer:
             # profiling)
             with profile_session(cfg.profile_dir, self.obs.profiling):
                 self._fit_epochs()
+        except HealthError:
+            # a halt must never abandon an in-flight async write: join this
+            # dir's writer before re-raising, surfacing any write failure
+            # as a warning rather than masking the halt itself
+            try:
+                ckpt.wait_for_async_save(cfg.checkpoint_dir or None)
+            except RuntimeError as we:
+                self.log(f"warning: async checkpoint write failed during "
+                         f"health halt: {we}")
+            raise
         except KeyboardInterrupt:
             self.obs.pause()  # slow interrupt-save is not a stall
             # strictly better than the reference (no try/except around its
@@ -797,7 +821,8 @@ class Trainer:
                                  self._epoch_in_progress, self.best_acc1,
                                  cfg.arch, is_best=False,
                                  extra_meta={"mid_epoch": True,
-                                             **self._run_meta})
+                                             **self._run_meta},
+                                 keep=cfg.keep_checkpoints)
             self.log(f"interrupted — checkpoint saved at epoch "
                      f"{self._epoch_in_progress}; resume with --resume")
             raise
@@ -845,7 +870,8 @@ class Trainer:
             t0_ck = time.time()
             ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, epoch + 1,
                                  self.best_acc1, cfg.arch, is_best,
-                                 extra_meta=self._run_meta, async_write=True)
+                                 extra_meta=self._run_meta, async_write=True,
+                                 keep=cfg.keep_checkpoints)
             self.obs.ledger.emit(
                 "ckpt", epoch=epoch + 1, path=cfg.checkpoint_dir,
                 is_best=is_best, seconds=round(time.time() - t0_ck, 6))

@@ -34,6 +34,7 @@ import time
 import traceback
 from typing import Optional
 
+from tpu_dist.obs import faults
 from tpu_dist.obs.attr import bucket_totals, cost_buckets, emit_cost_model
 from tpu_dist.obs.flightrec import FlightRecorder
 from tpu_dist.obs.goodput import (GoodputAccumulator, GoodputMonitor,
@@ -55,7 +56,8 @@ __all__ = ["EVENT_SCHEMA", "EpochCsvSink", "FlightRecorder",
            "HealthSentry", "Ledger", "MetricsRegistry", "ProgressSink",
            "RunObs", "SkewMonitor", "StepTracer", "Watchdog",
            "attempt_path", "bucket_totals", "cost_buckets",
-           "discover_attempt_paths", "emit_cost_model", "job_accounting",
+           "discover_attempt_paths", "emit_cost_model", "faults",
+           "job_accounting",
            "metrics_ledger_sink", "next_attempt_index", "per_process_path",
            "phase_totals", "profile_session", "read_ledger",
            "serve_metrics", "split_attempts", "step_annotation"]
@@ -165,6 +167,18 @@ class RunObs:
             unit=unit)
         self.ledger.add_sink(self.goodput.sink)
         self._prev_sigusr1 = None
+        # deterministic fault injection (obs.faults): the config knob wins
+        # over TPU_DIST_FAULTS; ledger + attempt context registered at
+        # run_start so every injection site (checkpoint writer, launch)
+        # can emit its 'fault' event without new plumbing
+        if getattr(cfg, "faults", ""):
+            faults.install(cfg.faults)
+        # supervisor liveness: touch a heartbeat file at each proven-progress
+        # beat (parallel.supervisor sets the env var for its children; the
+        # ledger tail is the other liveness signal)
+        self._hb_path = os.environ.get("TPU_DIST_HEARTBEAT_FILE", "") \
+            if self.is_main else ""
+        self._hb_last = 0.0
         self.peak_tflops, self.peak_is_nominal = effective_peak_tflops()
         self._mesh_info = (
             {name: int(size) for name, size in mesh.shape.items()}
@@ -182,6 +196,18 @@ class RunObs:
 
         self._t0 = time.time()
         self._ended = False
+        faults.set_ledger(self.ledger)
+        # fault-gating context: under a supervisor, TPU_DIST_ATTEMPT (its
+        # launch counter) is authoritative — the ledger ordinal does not
+        # advance across ledgerless deaths (a pre-RunObs rendezvous crash),
+        # so gating on it would aim attempt-conditioned faults at the
+        # wrong launch. Standalone runs have no env var; the two coincide.
+        try:
+            fault_attempt = int(
+                os.environ.get("TPU_DIST_ATTEMPT", "") or self.attempt)
+        except ValueError:
+            fault_attempt = self.attempt
+        faults.set_context(attempt=fault_attempt)
         self.ledger.emit(
             "run_start", kind=self.kind,
             config=dataclasses.asdict(self.cfg)
@@ -373,6 +399,14 @@ class RunObs:
                              n_steps=steps_in_dispatch)
         return rec
 
+    def fire_step_faults(self, step: int) -> set:
+        """Step-scoped fault-injection check (obs.faults), called by the
+        loops once per dispatch iteration: the process-level sites
+        (hard_exit/hang/preempt_sigterm) act inside, and the returned set
+        names the data-level effects the loop must apply itself (at most
+        ``{"nan_batch"}``). No-op and near-free when no plan is active."""
+        return faults.fire_step(step, ledger=self.ledger)
+
     def heartbeat(self) -> None:
         """Device progress proven (a drain's blocking device_get returned)
         — the watchdog's arming signal. The loops call this at every drain
@@ -382,6 +416,19 @@ class RunObs:
         and prove nothing about the devices (Watchdog.beat)."""
         if self.watchdog is not None:
             self.watchdog.beat()
+        # supervisor liveness: proven progress also touches the heartbeat
+        # file (parallel.supervisor watches its mtime beside the ledger
+        # tail). Throttled and best-effort — liveness reporting must never
+        # take the run down, even on a full disk.
+        if self._hb_path:
+            now = time.time()
+            if now - self._hb_last >= 1.0:
+                self._hb_last = now
+                try:
+                    with open(self._hb_path, "w") as f:
+                        f.write(f"{now}\n")
+                except OSError:
+                    pass
 
     # -- phase transitions ---------------------------------------------
     def pause(self) -> None:

@@ -93,6 +93,12 @@ EVENT_SCHEMA = {
     # below the configured floor; auto-triggers the flight recorder
     # through the ledger-sink path like every other detector event
     "slo": ("step", "kind", "value", "floor"),
+    # one deterministic fault injection (obs.faults): site names the
+    # injection point (nan_batch|hard_exit|hang|preempt_sigterm|
+    # ckpt_enospc|rendezvous_fail), spec the matched entry; step/attempt
+    # may be None for non-step-scoped sites. Reports use these to keep
+    # injected failures distinguishable from organic ones
+    "fault": ("site", "step", "spec"),
     # run rollup: total steps, wall seconds, best metric in extras;
     # status ("ok"|"crashed"|"interrupted") rides as an extra stamped by
     # RunObs.run_end — the crash-safe shutdown path sets "crashed"

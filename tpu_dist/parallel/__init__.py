@@ -1,10 +1,55 @@
-from tpu_dist.parallel.mesh import (  # noqa: F401
-    DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS,
-    batch_sharding, make_mesh, replicated, world_info)
-from tpu_dist.parallel.collectives import (  # noqa: F401
-    allreduce_bench, barrier, compress_grads, pmean, psum, reduce_mean,
-    ring_allreduce)
-from tpu_dist.parallel.overlap import (  # noqa: F401
-    RingDense, bucketed_grad_sync, ring_allgather_matmul,
-    ring_matmul_reduce_scatter, validate_tp_impl)
-from tpu_dist.parallel import launch  # noqa: F401
+"""tpu_dist.parallel — meshes, collectives, parallelism layouts, launch.
+
+Attribute access is LAZY (PEP 562): ``tpu_dist.parallel.supervisor`` (the
+elastic run supervisor) and its CLI must import on a login/CI host with no
+jax installed, but the historical eager re-exports below pull
+``parallel.mesh`` -> jax at package-import time. The mapping preserves the
+public surface exactly — ``from tpu_dist.parallel import launch`` and
+``from tpu_dist.parallel import make_mesh`` both still work — while
+deferring the jax-heavy module imports to first use.
+"""
+
+import importlib
+
+# public name -> submodule that defines it (None = the submodule itself)
+_LAZY = {
+    "launch": None,
+    "mesh": None,
+    "collectives": None,
+    "overlap": None,
+    "supervisor": None,
+    "fsdp": None,
+    "tp": None,
+    "ep": None,
+    "pp": None,
+    "ring_attention": None,
+    # parallel.mesh
+    "DATA_AXIS": "mesh", "FSDP_AXIS": "mesh", "MODEL_AXIS": "mesh",
+    "SEQ_AXIS": "mesh", "batch_sharding": "mesh", "make_mesh": "mesh",
+    "replicated": "mesh", "world_info": "mesh",
+    # parallel.collectives
+    "allreduce_bench": "collectives", "barrier": "collectives",
+    "compress_grads": "collectives", "pmean": "collectives",
+    "psum": "collectives", "reduce_mean": "collectives",
+    "ring_allreduce": "collectives",
+    # parallel.overlap
+    "RingDense": "overlap", "bucketed_grad_sync": "overlap",
+    "ring_allgather_matmul": "overlap",
+    "ring_matmul_reduce_scatter": "overlap", "validate_tp_impl": "overlap",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if name not in _LAZY:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    if target is None:
+        return importlib.import_module(f"{__name__}.{name}")
+    module = importlib.import_module(f"{__name__}.{target}")
+    return getattr(module, name)
+
+
+def __dir__():
+    return __all__

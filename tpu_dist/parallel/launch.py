@@ -21,8 +21,12 @@ from __future__ import annotations
 
 import os
 import re
+import sys
+import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
+
+from tpu_dist.obs import faults as _faults
 
 
 @dataclass
@@ -70,6 +74,62 @@ def detect_launch(coordinator: Optional[str] = None,
     return LaunchInfo(None, 1, 0, "local")
 
 
+def rendezvous_with_retry(init_fn: Callable[[], None], info: LaunchInfo,
+                          retries: Optional[int] = None,
+                          timeout_s: Optional[float] = None,
+                          backoff_s: Optional[float] = None,
+                          sleep: Callable[[float], None] = time.sleep) -> int:
+    """Bounded retry + exponential backoff around one rendezvous call.
+
+    A flaky coordinator (still booting, preempted mid-restart, transient
+    DNS) used to surface as a raw grpc stack from deep inside
+    ``jax.distributed.initialize``; a supervised restart needs the
+    rendezvous to *ride out* the window where peers come back up. Retries
+    ``init_fn`` up to ``TPU_DIST_RENDEZVOUS_RETRIES`` times (default 5)
+    with ``TPU_DIST_RENDEZVOUS_BACKOFF_S``-based exponential backoff
+    (default 2s, doubling, capped at 30s) under a
+    ``TPU_DIST_RENDEZVOUS_TIMEOUT_S`` TOTAL deadline (default 300s).
+    Returns the number of attempts used; on exhaustion raises ONE clear
+    error naming the coordinator, method, and attempt count. The
+    ``rendezvous_fail`` fault site (obs.faults) injects the failure
+    deterministically — ``times=K`` fails the first K attempts."""
+    env = os.environ
+    retries = int(env.get("TPU_DIST_RENDEZVOUS_RETRIES", "5")
+                  if retries is None else retries)
+    timeout_s = float(env.get("TPU_DIST_RENDEZVOUS_TIMEOUT_S", "300")
+                      if timeout_s is None else timeout_s)
+    backoff_s = float(env.get("TPU_DIST_RENDEZVOUS_BACKOFF_S", "2")
+                      if backoff_s is None else backoff_s)
+    t0 = time.monotonic()
+    last: Optional[BaseException] = None
+    for attempt in range(1, max(retries, 1) + 1):
+        try:
+            if _faults.fire("rendezvous_fail", attempt_no=attempt):
+                raise ConnectionError("injected rendezvous failure")
+            init_fn()
+            return attempt
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # grpc failures arrive as assorted types
+            last = e
+            elapsed = time.monotonic() - t0
+            wait = min(backoff_s * (2 ** (attempt - 1)), 30.0)
+            if attempt >= retries or elapsed + wait >= timeout_s:
+                break
+            print(f"rendezvous attempt {attempt}/{retries} with "
+                  f"{info.coordinator} failed ({e}); retrying in "
+                  f"{wait:.1f}s", file=sys.stderr, flush=True)
+            sleep(wait)
+    raise RuntimeError(
+        f"rendezvous failed: could not reach coordinator "
+        f"{info.coordinator!r} ({info.method} method, process "
+        f"{info.process_id}/{info.num_processes}) after {attempt} "
+        f"attempt(s) over {time.monotonic() - t0:.1f}s "
+        f"(TPU_DIST_RENDEZVOUS_RETRIES={retries}, "
+        f"TPU_DIST_RENDEZVOUS_TIMEOUT_S={timeout_s:g}). "
+        f"Last error: {last}") from last
+
+
 def initialize(coordinator: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None) -> LaunchInfo:
@@ -110,7 +170,10 @@ def initialize(coordinator: Optional[str] = None,
                 "INVALID_ARGUMENT after rendezvous. Upgrade jax or run "
                 "single-process with virtual devices "
                 "(_compat.set_cpu_device_count).")
-    jax.distributed.initialize(coordinator_address=info.coordinator,
-                               num_processes=info.num_processes,
-                               process_id=info.process_id)
+    rendezvous_with_retry(
+        lambda: jax.distributed.initialize(
+            coordinator_address=info.coordinator,
+            num_processes=info.num_processes,
+            process_id=info.process_id),
+        info)
     return info

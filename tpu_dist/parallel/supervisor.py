@@ -1,0 +1,561 @@
+"""Elastic run supervisor: close the detect->remediate loop.
+
+Rounds 2-7 built the *detect* half of self-healing — watchdog stalls,
+``health record|skip|halt``, auto-triggered flight recorder, restart-aware
+``job_id``/``attempt`` lineage with ``restart_gap`` goodput — but nothing
+ever acted: a hang, a ``HealthError`` halt, a preemption or a crashed host
+simply ended the run, and recovery was a human re-running the script. The
+reference's variant 6 (``6.distributed_slurm_main.py``) leaned on Slurm
+``--requeue`` for exactly this; the torch ecosystem answer is
+torchelastic's supervised restarts. This module is the TPU-native version,
+in two flavors:
+
+* **Subprocess CLI** — ``python -m tpu_dist.supervise --ledger run.jsonl
+  --ckpt-dir ck -- python scripts/8.lm_longcontext.py ...``:
+  :class:`Supervisor` launches the training command, watches liveness
+  through the attempt ledger's tail and a heartbeat file, classifies every
+  exit (:func:`classify_attempt`), and restarts under a bounded policy —
+  ``attempt=-1`` auto-lineage so PR 7's stitching/goodput sees every
+  attempt, ``--resume`` pointed at the newest VALID checkpoint
+  (:func:`latest_checkpoint` — the pointer only ever names a committed
+  container), exponential backoff, crash-loop cutoff when K consecutive
+  attempts die before their first ``step`` event, and on confirmed
+  rendezvous/host loss a degraded dp-only relaunch on the survivors
+  (:func:`degraded_env`). A watchdog-confirmed stall (the child's own
+  ``stall`` ledger event with no progress after it) is SIGKILLed and
+  restarted — the one failure class where waiting is the wrong move.
+
+* **Library API** — :func:`run_supervised` wraps a trainer factory in the
+  same policy loop *in process* (both engine scripts opt in via the
+  ``max_restarts`` config knob): ``HealthError`` halts and organic
+  exceptions restart from the newest valid checkpoint with fresh attempt
+  lineage. Process-killing failures (``os._exit``, SIGKILL, host loss)
+  need the subprocess flavor by construction.
+
+Everything here is importable WITHOUT jax (``scripts/lint.sh`` runs the
+policy math on a bare host as a CI gate); the training child owns all
+device state. Deterministic fault injection for every path lives in
+:mod:`tpu_dist.obs.faults`.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpu_dist.obs.goodput import attempt_path, next_attempt_index
+
+# every attempt ends in exactly one of these
+FAILURE_CLASSES = ("clean", "health_halt", "stall", "preemption",
+                   "rendezvous", "crash")
+
+# ledger events that prove the run is making forward progress (the stall
+# event itself grows the ledger too — it must NOT reset the liveness clock)
+_PROGRESS_EVENTS = frozenset({
+    "run_start", "compile", "step", "epoch", "eval", "ckpt", "decode"})
+
+
+class CrashLoopError(RuntimeError):
+    """K consecutive attempts died before their first step — restarting
+    again would burn the allocation on the same deterministic failure."""
+
+
+@dataclass
+class RestartPolicy:
+    """Bounded-restart knobs (pure data; the no-jax lint gate imports it)."""
+
+    max_restarts: int = 10          # restarts, not attempts (N+1 attempts)
+    backoff_base_s: float = 1.0     # base * 2^(restart-1), capped below
+    backoff_max_s: float = 60.0
+    crash_loop_k: int = 3           # consecutive pre-first-step deaths
+    # idle backstop, deliberately generous: the FIRST liveness signal is
+    # the post-compile heartbeat, so this must exceed any first XLA
+    # compile (large LM programs take many minutes) — SIGKILLing a
+    # healthy compile would read as a pre-first-step death and trip the
+    # crash-loop cutoff. Real hangs are caught much faster by the
+    # child's own watchdog 'stall' event + stall_grace_s below.
+    stall_timeout_s: float = 1800.0  # ledger/heartbeat silence -> SIGKILL
+    stall_grace_s: float = 10.0     # after a watchdog 'stall' event lands
+    shrink_on_host_loss: bool = True
+
+
+def compute_backoff(restart_no: int, policy: RestartPolicy) -> float:
+    """Seconds to wait before restart #``restart_no`` (1-based):
+    exponential from ``backoff_base_s``, capped at ``backoff_max_s``."""
+    if restart_no <= 0:
+        return 0.0
+    return min(policy.backoff_base_s * (2.0 ** (restart_no - 1)),
+               policy.backoff_max_s)
+
+
+def classify_attempt(records: List[dict], returncode: Optional[int] = None,
+                     killed_for_stall: bool = False,
+                     stderr_tail: str = "") -> str:
+    """One attempt's failure class, from its ledger records + exit status.
+
+    Pure and jax-free: the supervisor calls it with the child's returncode
+    and captured stderr tail; ``tools/ledger_report`` calls it with
+    records alone (``returncode=None``) to classify attempts after the
+    fact. Precedence: a supervisor-confirmed stall kill beats everything
+    (the rc is just our own SIGKILL); then the run's own account
+    (``run_end`` status/error), then the exit code, then stderr."""
+    if killed_for_stall:
+        return "stall"
+    ends = [r for r in records if r.get("event") == "run_end"]
+    end = ends[-1] if ends else None
+    status = (end or {}).get("status")
+    err = str((end or {}).get("error") or "")
+    if returncode == 0 or (returncode is None and end is not None
+                           and status in (None, "ok")):
+        return "clean"
+    if "HealthError" in err or "health=halt" in err:
+        return "health_halt"
+    if ("SIGTERM" in err or status == "interrupted"
+            or returncode in (-signal.SIGTERM, 128 + signal.SIGTERM)):
+        return "preemption"
+    blob = (err + "\n" + stderr_tail).lower()
+    # only a launch-phase death (no run_end: the child never got far
+    # enough to account for itself) may be blamed on rendezvous, and only
+    # on the EXHAUSTION message — the retry wrapper's per-attempt
+    # "rendezvous attempt k/N ... retrying" warnings linger in the stderr
+    # tail of runs that rendezvoused fine and died later of other causes
+    if end is None and ("rendezvous failed" in blob
+                        or "could not reach coordinator" in blob
+                        or "deadline_exceeded" in blob):
+        return "rendezvous"
+    if end is None and any(r.get("event") == "stall" for r in records):
+        # the child died mid-stall without our kill (OOM-killer, operator)
+        return "stall"
+    return "crash"
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """The newest VALID checkpoint in a dir, without jax or
+    deserialization: the ``*-checkpoint.index.json`` pointer when present
+    (engine.checkpoint writes it only after a fully-committed container,
+    so an ENOSPC'd or torn write never advances it), else the newest
+    ``*-checkpoint.msgpack`` by mtime."""
+    if not ckpt_dir or not os.path.isdir(ckpt_dir):
+        return None
+    # newest pointer first, not alphabetical: a dir that ever held another
+    # arch's checkpoints must not resume this run from the wrong model
+    idx_files = sorted(glob.glob(
+        os.path.join(ckpt_dir, "*-checkpoint.index.json")),
+        key=os.path.getmtime, reverse=True)
+    for idx in idx_files:
+        try:
+            with open(idx) as f:
+                pointer = json.load(f)
+            path = os.path.join(ckpt_dir, pointer["newest"])
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+        if os.path.exists(path):
+            return path
+    cands = glob.glob(os.path.join(ckpt_dir, "*-checkpoint.msgpack"))
+    return max(cands, key=os.path.getmtime) if cands else None
+
+
+def degraded_env(env: Dict[str, str],
+                 lost: int = 1) -> Tuple[Dict[str, str], int]:
+    """The relaunch environment after confirmed host loss: the mesh
+    re-forms on the survivors (``TPU_DIST_NUM_PROCESSES`` shrunk by
+    ``lost``) and ``TPU_DIST_DEGRADED=1`` marks the run so reports can
+    tell a degraded layout from the planned one. Returns (env, survivors).
+    Pure — unit-testable without processes.
+
+    KNOWN LIMIT: ``TPU_DIST_PROCESS_ID`` is NOT renumbered — each host's
+    supervisor only sees its own env, and closing an id hole left by a
+    mid-numbered host needs cross-host consensus (ROADMAP item 2's
+    remaining ambition). Until then the shrunken rendezvous re-forms
+    cleanly when the LOST host held the highest id (ids stay dense) and
+    for the 1-survivor case every test exercises; a mid-host loss still
+    ends in a bounded restarts_exhausted instead of a hang."""
+    n = int(env.get("TPU_DIST_NUM_PROCESSES", "1") or 1)
+    survivors = max(n - max(lost, 0), 1)
+    out = dict(env)
+    if survivors < n:
+        out["TPU_DIST_NUM_PROCESSES"] = str(survivors)
+        out["TPU_DIST_DEGRADED"] = "1"
+    return out, survivors
+
+
+# the dp-only degraded layout: mesh shape reset to auto (all remaining
+# devices) over the plain data axis — appended on relaunch after shrink
+DEGRADED_FLAGS = ("--mesh-shape", "", "--mesh-axes", "data")
+
+
+@dataclass
+class AttemptResult:
+    attempt: int
+    returncode: Optional[int]
+    failure_class: str
+    steps: int
+    seconds: float
+    ledger: str = ""
+
+
+@dataclass
+class SupervisorResult:
+    status: str  # clean | crash_loop | restarts_exhausted
+    attempts: List[AttemptResult] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "clean"
+
+
+class _StderrTail(threading.Thread):
+    """Forward the child's stderr to ours while keeping the last N lines
+    (classification evidence for deaths that never reached the ledger)."""
+
+    def __init__(self, pipe, maxlen: int = 50):
+        super().__init__(name="supervise-stderr", daemon=True)
+        self._pipe = pipe
+        self.lines: deque = deque(maxlen=maxlen)
+
+    def run(self) -> None:
+        try:
+            for line in self._pipe:
+                self.lines.append(line)
+                sys.stderr.write(line)
+        except ValueError:
+            pass  # pipe closed under us at kill time
+        finally:
+            try:
+                self._pipe.close()
+            except OSError:
+                pass
+
+    def tail(self) -> str:
+        return "".join(self.lines)
+
+
+class _LedgerTail:
+    """Incremental reader of an attempt ledger: which events arrived since
+    the last poll (partial trailing lines are held back, not mangled)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+        self._partial = b""
+
+    def poll(self) -> List[str]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size <= self._offset:
+            return []
+        with open(self.path, "rb") as f:
+            f.seek(self._offset)
+            chunk = f.read(size - self._offset)
+        self._offset = size
+        data = self._partial + chunk
+        lines = data.split(b"\n")
+        self._partial = lines.pop()  # "" on a complete trailing newline
+        events = []
+        for line in lines:
+            try:
+                rec = json.loads(line)
+                ev = rec.get("event")
+                if ev:
+                    events.append(ev)
+            except (ValueError, AttributeError):
+                continue  # torn line mid-crash: liveness only, not truth
+        return events
+
+
+def _read_records(path: str) -> List[dict]:
+    """Best-effort full read of an attempt ledger (schema-lenient: the
+    crashed child is exactly the one with torn lines)."""
+    from tpu_dist.obs.ledger import read_ledger
+
+    try:
+        return read_ledger(path, validate=False, strict=False)
+    except OSError:
+        return []
+
+
+class Supervisor:
+    """Launch, watch, classify, restart — the policy loop around one
+    training command. See the module docstring for the contract; every
+    knob of :class:`RestartPolicy` applies."""
+
+    def __init__(self, cmd: List[str], ledger: str, ckpt_dir: str = "",
+                 policy: Optional[RestartPolicy] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 forward_flags: bool = True, poll_s: float = 0.25,
+                 sleep: Callable[[float], None] = time.sleep):
+        if not cmd:
+            raise ValueError("supervisor needs a training command "
+                             "(everything after '--')")
+        if not ledger:
+            raise ValueError("supervisor needs --ledger: the attempt "
+                             "ledgers are its liveness + lineage signal")
+        self.cmd = list(cmd)
+        self.ledger = ledger
+        self.ckpt_dir = ckpt_dir
+        self.policy = policy or RestartPolicy()
+        self.env = dict(os.environ if env is None else env)
+        self.forward_flags = forward_flags
+        self.poll_s = poll_s
+        self._sleep = sleep
+        self.degraded = False
+
+    def _log(self, msg: str) -> None:
+        print(f"[supervise] {msg}", file=sys.stderr, flush=True)
+
+    # -- one attempt ----------------------------------------------------
+    def _child_argv(self, resume: Optional[str]) -> List[str]:
+        argv = list(self.cmd)
+        if self.forward_flags:
+            # argparse last-wins: the lineage/resume flags override
+            # whatever the base command carries
+            argv += ["--ledger-path", self.ledger, "--attempt", "-1"]
+            if self.ckpt_dir:
+                argv += ["--checkpoint-dir", self.ckpt_dir]
+            if resume:
+                argv += ["--resume", resume]
+            if self.degraded:
+                argv += list(DEGRADED_FLAGS)
+        return argv
+
+    def _run_child(self, argv: List[str], env: Dict[str, str],
+                   attempt_file: str,
+                   hb_file: str) -> Tuple[Optional[int], bool, str]:
+        """(returncode, killed_for_stall, stderr_tail) for one attempt."""
+        pol = self.policy
+        proc = subprocess.Popen(argv, env=env, stderr=subprocess.PIPE,
+                                text=True, errors="replace")
+        tail = _StderrTail(proc.stderr)
+        tail.start()
+        try:
+            ledger_tail = _LedgerTail(attempt_file)
+            last_progress = time.monotonic()
+            stall_confirmed: Optional[float] = None
+            killed_for_stall = False
+            hb_mtime = 0.0
+            while proc.poll() is None:
+                self._sleep(self.poll_s)
+                now = time.monotonic()
+                progressed = False
+                for ev in ledger_tail.poll():
+                    if ev in _PROGRESS_EVENTS:
+                        progressed = True
+                        stall_confirmed = None  # the run moved again
+                    elif ev == "stall":
+                        stall_confirmed = stall_confirmed or now
+                try:
+                    mt = os.path.getmtime(hb_file)
+                    if mt > hb_mtime:
+                        hb_mtime = mt
+                        # a heartbeat only counts while no stall is
+                        # confirmed: the watchdog thread's own dump must
+                        # not keep a hung step loop alive forever
+                        if stall_confirmed is None:
+                            progressed = True
+                except OSError:
+                    pass
+                if progressed:
+                    last_progress = now
+                    continue
+                idle = now - last_progress
+                if ((stall_confirmed is not None
+                     and now - stall_confirmed >= pol.stall_grace_s)
+                        or idle >= pol.stall_timeout_s):
+                    why = ("watchdog-confirmed stall" if stall_confirmed
+                           else "no ledger/heartbeat progress for "
+                                f"{idle:.0f}s")
+                    self._log(f"{why} — SIGKILLing pid {proc.pid} "
+                              "for restart")
+                    killed_for_stall = True
+                    proc.kill()
+                    break
+            rc = proc.wait()
+        finally:
+            # the supervisor must NEVER orphan a live trainer: a dying
+            # supervisor (SIGTERM'd by the scheduler — run() converts it
+            # to SystemExit so this unwinds — or any internal error)
+            # would otherwise leave the child racing its own requeue on
+            # the same ledger + checkpoint dir. SIGTERM first (the crash
+            # guard gets its run_end), SIGKILL if it lingers.
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+        tail.join(timeout=5.0)
+        return rc, killed_for_stall, tail.tail()
+
+    # -- the policy loop ------------------------------------------------
+    def run(self) -> SupervisorResult:
+        # a SIGTERM'd supervisor (scheduler preemption signals THIS pid,
+        # not the child) must unwind through _run_child's finally and take
+        # the child down with it; default SIGTERM disposition would kill
+        # the supervisor instantly and orphan a live trainer. Library
+        # callers on non-main threads keep their own handling.
+        prev_term = None
+        try:
+            prev_term = signal.signal(
+                signal.SIGTERM,
+                lambda signum, frame: sys.exit(128 + signum))
+        except ValueError:
+            pass  # not the main thread
+        try:
+            return self._run_policy_loop()
+        finally:
+            if prev_term is not None:
+                signal.signal(signal.SIGTERM, prev_term)
+
+    def _run_policy_loop(self) -> SupervisorResult:
+        pol = self.policy
+        attempts: List[AttemptResult] = []
+        consecutive_dead = 0
+        restarts = 0
+        while True:
+            # two counters on purpose: the LEDGER ordinal only advances
+            # when a child lived long enough to create its attempt file (a
+            # pre-RunObs death must not burn a lineage slot), while the
+            # supervisor's own attempt number always advances — it is what
+            # TPU_DIST_ATTEMPT exports, so attempt-gated faults and
+            # diagnostics see every launch, including the ledgerless ones
+            attempt_no = len(attempts)
+            ordinal = next_attempt_index(self.ledger)
+            attempt_file = attempt_path(self.ledger, ordinal)
+            resume = (latest_checkpoint(self.ckpt_dir)
+                      if self.ckpt_dir else None)
+            argv = self._child_argv(resume)
+            env = dict(self.env)
+            env["TPU_DIST_ATTEMPT"] = str(attempt_no)
+            hb_file = attempt_file + ".hb"
+            env["TPU_DIST_HEARTBEAT_FILE"] = hb_file
+            self._log(f"attempt {attempt_no}: {' '.join(argv)}"
+                      + (f" (resume {resume})" if resume else ""))
+            t0 = time.monotonic()
+            rc, killed_for_stall, stderr_tail = self._run_child(
+                argv, env, attempt_file, hb_file)
+            records = _read_records(attempt_file)
+            cls = classify_attempt(records, rc, killed_for_stall,
+                                   stderr_tail)
+            steps = sum(1 for r in records if r.get("event") == "step")
+            result = AttemptResult(attempt_no, rc, cls, steps,
+                                   round(time.monotonic() - t0, 3),
+                                   ledger=attempt_file)
+            attempts.append(result)
+            self._log(f"attempt {attempt_no} ended: rc={rc} class={cls} "
+                      f"({steps} step record(s) in {result.seconds:.1f}s)")
+            if cls == "clean":
+                return SupervisorResult("clean", attempts)
+            consecutive_dead = consecutive_dead + 1 if steps == 0 else 0
+            if consecutive_dead >= pol.crash_loop_k:
+                self._log(
+                    f"CRASH LOOP: {consecutive_dead} consecutive attempts "
+                    f"died before their first step (last class {cls!r}) — "
+                    "the failure is deterministic, not transient; fix the "
+                    "run instead of restarting it")
+                return SupervisorResult("crash_loop", attempts)
+            if restarts >= pol.max_restarts:
+                self._log(f"giving up: {restarts} restart(s) used "
+                          f"(max_restarts={pol.max_restarts})")
+                return SupervisorResult("restarts_exhausted", attempts)
+            # shrink only on the SECOND consecutive rendezvous failure:
+            # the first full-size retry rides out a transient coordinator
+            # outage (the common case); a repeat is the host-loss signal
+            if cls == "rendezvous" and pol.shrink_on_host_loss:
+                rdzv_streak = 0
+                for a in reversed(attempts):
+                    if a.failure_class != "rendezvous":
+                        break
+                    rdzv_streak += 1
+                if rdzv_streak >= 2:
+                    self.env, survivors = degraded_env(self.env)
+                    if self.env.get("TPU_DIST_DEGRADED") == "1":
+                        self.degraded = True
+                        self._log("host loss confirmed (2 consecutive "
+                                  "rendezvous failures) — re-forming the "
+                                  f"mesh dp-only on {survivors} surviving "
+                                  "process(es)")
+            restarts += 1
+            wait = compute_backoff(restarts, pol)
+            self._log(f"restart {restarts}/{pol.max_restarts} in "
+                      f"{wait:.1f}s")
+            self._sleep(wait)
+
+
+# -- in-process library API (the engines' config opt-in) --------------------
+
+def run_supervised(make_trainer: Callable, cfg, *,
+                   policy: Optional[RestartPolicy] = None,
+                   sleep: Callable[[float], None] = time.sleep):
+    """Policy-looped ``make_trainer(cfg).fit()``: the in-process flavor.
+
+    Each attempt rebuilds the trainer with ``attempt=-1`` auto-lineage and
+    ``resume`` pointed at the newest valid checkpoint, so a ``HealthError``
+    halt (or any organic exception) restarts from the last good state with
+    the restart visible in the stitched ledger. Bounded by the same
+    :class:`RestartPolicy` (defaults come from the config's
+    ``max_restarts`` / ``restart_backoff_s`` / ``crash_loop_k`` knobs);
+    exhaustion re-raises the last failure, a crash loop raises
+    :class:`CrashLoopError`. Process-killing failures (``os._exit``,
+    SIGKILL, host loss) need the subprocess CLI by construction."""
+    import dataclasses
+
+    from tpu_dist.obs.health import HealthError
+
+    if policy is None:
+        policy = RestartPolicy(
+            max_restarts=int(getattr(cfg, "max_restarts", 0) or 0),
+            backoff_base_s=float(getattr(cfg, "restart_backoff_s", 1.0)
+                                 or 0.0),
+            crash_loop_k=int(getattr(cfg, "crash_loop_k", 3) or 3))
+    restarts = 0
+    consecutive_dead = 0
+    while True:
+        resume = getattr(cfg, "resume", "")
+        if restarts > 0 and getattr(cfg, "checkpoint_dir", ""):
+            resume = latest_checkpoint(cfg.checkpoint_dir) or resume
+        run_cfg = dataclasses.replace(
+            cfg, resume=resume,
+            attempt=-1 if getattr(cfg, "ledger_path", "") else
+            getattr(cfg, "attempt", 0))
+        trainer = None  # drop the dead attempt's params/opt-state BEFORE
+        # the rebuild re-allocates them — restarts must fit in HBM
+        try:
+            # construction is INSIDE the policy: an OOM while the rebuild
+            # re-allocates, or an FS blip loading the resume checkpoint,
+            # is a classifiable pre-first-step death (backoff + crash-loop
+            # counting), same as a child dying at startup in the
+            # subprocess flavor — not an abort of the whole supervised run
+            trainer = make_trainer(run_cfg)
+            return trainer.fit()
+        except KeyboardInterrupt:
+            raise  # the operator's ^C is not a failure to remediate
+        except Exception as e:
+            cls = "health_halt" if isinstance(e, HealthError) else "crash"
+            steps = int(getattr(getattr(trainer, "obs", None), "steps", 0)
+                        or 0)
+            consecutive_dead = consecutive_dead + 1 if steps == 0 else 0
+            if consecutive_dead >= policy.crash_loop_k:
+                raise CrashLoopError(
+                    f"{consecutive_dead} consecutive attempts died before "
+                    f"their first step (last: {cls}: {e}) — deterministic "
+                    "failure, not restarting") from e
+            if restarts >= policy.max_restarts:
+                raise
+            restarts += 1
+            wait = compute_backoff(restarts, policy)
+            print(f"[supervise] {cls}: {e}\n[supervise] in-process restart "
+                  f"{restarts}/{policy.max_restarts} in {wait:.1f}s",
+                  file=sys.stderr, flush=True)
+            sleep(wait)

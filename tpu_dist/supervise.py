@@ -1,0 +1,81 @@
+"""CLI for the elastic run supervisor (tpu_dist.parallel.supervisor).
+
+    python -m tpu_dist.supervise --ledger run.jsonl --ckpt-dir ck -- \\
+        python scripts/8.lm_longcontext.py --epochs 4 --batch-size 32
+
+The supervisor launches the command after ``--``, appends the lineage
+flags (``--ledger-path``/``--attempt -1``/``--checkpoint-dir`` and, on
+restarts, ``--resume <newest valid checkpoint>``), watches liveness via
+the attempt ledger's tail + a heartbeat file, classifies every exit, and
+restarts under a bounded policy (exponential backoff, crash-loop cutoff,
+degraded dp-only relaunch on confirmed host loss). Exit code 0 iff the
+run completed cleanly. Runs without jax — the child owns the devices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from tpu_dist.parallel.supervisor import (RestartPolicy, Supervisor,
+                                          SupervisorResult)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = RestartPolicy()
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dist.supervise",
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    ap.add_argument("--ledger", required=True,
+                    help="base ledger path; attempts write <stem>.aN.jsonl")
+    ap.add_argument("--ckpt-dir", default="",
+                    help="checkpoint dir to resume restarts from "
+                    "(newest-valid pointer; empty = no auto-resume)")
+    ap.add_argument("--max-restarts", type=int, default=p.max_restarts)
+    ap.add_argument("--backoff-s", type=float, default=p.backoff_base_s,
+                    help="restart backoff base (doubles per restart)")
+    ap.add_argument("--backoff-max-s", type=float, default=p.backoff_max_s)
+    ap.add_argument("--crash-loop-k", type=int, default=p.crash_loop_k,
+                    help="stop after K consecutive pre-first-step deaths")
+    ap.add_argument("--stall-timeout-s", type=float,
+                    default=p.stall_timeout_s,
+                    help="SIGKILL after this much ledger/heartbeat silence")
+    ap.add_argument("--stall-grace-s", type=float, default=p.stall_grace_s,
+                    help="SIGKILL this long after a watchdog 'stall' event "
+                    "with no progress")
+    ap.add_argument("--no-forward-flags", action="store_true",
+                    help="do not append --ledger-path/--attempt/--resume "
+                    "to the command (it manages its own lineage)")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="never shrink the mesh on rendezvous/host loss")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- then the training command")
+    return ap
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    policy = RestartPolicy(
+        max_restarts=args.max_restarts, backoff_base_s=args.backoff_s,
+        backoff_max_s=args.backoff_max_s, crash_loop_k=args.crash_loop_k,
+        stall_timeout_s=args.stall_timeout_s,
+        stall_grace_s=args.stall_grace_s,
+        shrink_on_host_loss=not args.no_shrink)
+    sup = Supervisor(cmd, ledger=args.ledger, ckpt_dir=args.ckpt_dir,
+                     policy=policy,
+                     forward_flags=not args.no_forward_flags)
+    result: SupervisorResult = sup.run()
+    print(f"[supervise] {result.status}: {len(result.attempts)} attempt(s) "
+          + ", ".join(f"a{a.attempt}={a.failure_class}"
+                      for a in result.attempts),
+          file=sys.stderr, flush=True)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
