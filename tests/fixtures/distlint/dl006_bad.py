@@ -11,3 +11,9 @@ def emit_badly(ledger, name, fields):
 def emit_fault_badly(led):
     # round 10: the fault-injection event is schema-checked like the rest
     led.emit("fault", spec="hard_exit@step=3")  # missing site + step
+
+
+def emit_serving_badly(ledger):
+    # round 11: the serving events (engine.serve) are schema-checked too
+    ledger.emit("request", rid=7, tokens=12)   # missing the timeline fields
+    ledger.emit("kv_cache", pages_free=3)      # missing used/active_seqs

@@ -16,3 +16,15 @@ def emit_fault_well(led):
     # round 10: obs.faults' injection record (site/step/spec required)
     led.emit("fault", site="hard_exit", step=3,
              spec="hard_exit@step=3,attempt=0", attempt=0)
+
+
+def emit_serving_well(ledger):
+    # round 11: the serving events (engine.serve) — admission decision,
+    # completed request, and paged-pool pressure snapshot
+    ledger.emit("admit", rid=7, accepted=False, queue_depth=9,
+                pages_free=0, reason="page_watermark")
+    ledger.emit("request", rid=7, tokens=12, queue_wait_s=0.25,
+                admit_ts=1.0, first_token_ts=1.5, finish_ts=2.0,
+                prompt_len=8, ttft_s=0.5)
+    ledger.emit("kv_cache", pages_free=3, pages_used=13, active_seqs=4,
+                pages_total=16, high_water_used=16, slots=4, tick=40)

@@ -103,6 +103,26 @@ def test_cached_decode_matches_sampling_stream():
     np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
 
 
+def test_sample_truncation_unit():
+    """The sampler math alone (engine.generate._sample, no model): top_k=1
+    == argmax at any temperature, a peaked small-p nucleus == argmax, a
+    permissive nucleus stays in-vocab — the cheap tier-1 sibling of the
+    model-level truncation tests below (slow-marked, PR 11 budget)."""
+    from tpu_dist.engine.generate import _sample
+
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.normal(0, 1, (4, V)).astype(np.float32))
+    greedy = np.asarray(jnp.argmax(logits, axis=-1))
+    k1, _ = _sample(logits, 2.0, jax.random.PRNGKey(0), top_k=1)
+    np.testing.assert_array_equal(greedy, np.asarray(k1))
+    peaked, _ = _sample(logits, 0.05, jax.random.PRNGKey(1), top_p=0.5)
+    np.testing.assert_array_equal(greedy, np.asarray(peaked))
+    free, _ = _sample(logits, 1.0, jax.random.PRNGKey(2), top_p=0.9)
+    free = np.asarray(free)
+    assert free.min() >= 0 and free.max() < V
+
+
+@pytest.mark.slow  # tier-1 budget (PR 11): model-level twin of the _sample truncation unit above (test_sample_truncation_unit keeps k-truncation pinned in-budget)
 def test_top_k_restricts_to_best_tokens():
     """top_k=1 sampling == greedy argmax regardless of temperature/rng."""
     lm, params = _lm_and_params(seed=6)
@@ -113,6 +133,7 @@ def test_top_k_restricts_to_best_tokens():
     np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 11): model-level twin of the _sample truncation unit (test_sample_truncation_unit keeps nucleus masking pinned in-budget)
 def test_top_p_nucleus_keeps_valid_tokens():
     """top_p sampling only ever emits tokens inside the nucleus: with a
     peaked distribution and small p, it matches greedy."""
@@ -168,6 +189,7 @@ def test_mesh_tp_decode_matches_single_device():
         np.testing.assert_array_equal(np.asarray(single), np.asarray(tp))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 11): the dp x tp composition of two single-axis parity pins that stay in-budget (test_mesh_data_sharded_decode_matches_single_device, test_mesh_tp_decode_matches_single_device)
 def test_mesh_dp_tp_decode_matches_single_device():
     """2-D ('data','model') decode: batch AND heads sharded together."""
     lm, params = _lm_and_params(seed=13)
@@ -226,6 +248,7 @@ def test_moe_cached_decode_matches_full_recompute():
     np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 11): MoE twin of the dense rng-stream pin (test_cached_decode_matches_sampling_stream stays; test_moe_cached_decode_batched_is_valid keeps MoE cached mechanics in-budget)
 def test_moe_cached_decode_sampling_stream():
     moe, params = _moe_and_params(seed=22, capacity_factor=2.0)
     prompt = jnp.asarray([[5, 1, 8, 2]], jnp.int32)

@@ -570,3 +570,58 @@ def test_two_attempt_lm_smoke_goodput_slo_flightrec(tmp_path):
     assert [s["attempt"] for s in starts] == [0, 1]
     assert all(s["job_id"] == "run" for s in starts)
     assert starts[1]["resumed_from"] == cfg2.resume
+
+
+def test_decode_bench_trace_replay_cli(tmp_path):
+    """The throughput-under-load acceptance pin, on the real CLI surface:
+    `decode_bench --trace` replays one seeded Poisson trace through the
+    continuous-batching engine AND static drain-batching at equal slot
+    capacity, and the headline JSON's `serving` block must show continuous
+    strictly ahead on completed-requests-per-tick and occupancy (both are
+    deterministic schedule arithmetic — the wall req/s rides along for the
+    dashboards). Tiny geometry: the pin is the comparison, not the scale."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [_sys.executable, "tools/decode_bench.py", "--batch", "2",
+         "--prompt-len", "8", "--steps", "4", "--vocab-size", "64",
+         "--d-model", "32", "--num-layers", "1", "--num-heads", "2",
+         "--skip-full", "--trials", "1", "--requests", "0",
+         "--trace", "12", "--min-prompt", "4", "--max-prompt", "12",
+         "--min-out", "2", "--max-out", "12", "--serve-slots", "3",
+         "--page-size", "8"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    head = json.loads(out.stdout.strip().splitlines()[-1])
+    srv = head["serving"]
+    assert srv["requests"] == 12 and srv["completed"] == 12
+    static = srv["static"]
+    assert static["completed"] == 12
+    # the perf pin: strictly more completed work per tick, busier slots
+    assert srv["requests_per_tick"] > static["requests_per_tick"], srv
+    assert srv["occupancy"] > static["occupancy"], srv
+    assert srv["requests_per_sec"] > 0 and srv["tokens_per_sec"] > 0
+    assert srv["ttft_ms"]["p99"] >= srv["ttft_ms"]["p50"] > 0
+    assert srv["tpot_ms"]["p50"] > 0
+    # and bench_track judges the serving number like data_s: a regressed
+    # replay fails the gate, pre-serving history abstains
+    from tools.bench_track import load_points, track
+
+    hp = tmp_path / "head.json"
+    hp.write_text(json.dumps(head))
+    points = load_points([str(hp)])
+    assert points[0]["serving_rpt"] == srv["requests_per_tick"]
+    report = track(points, threshold_pct=5.0)
+    m = report["metrics"][head["metric"]]
+    assert m["serving_latest"] == srv["requests_per_tick"]
+    assert m["serving_best_prior"] is None  # abstains: no prior history
+    worse = dict(head, serving=dict(srv, requests_per_tick=srv[
+        "requests_per_tick"] * 0.5))
+    wp = tmp_path / "worse.json"
+    wp.write_text(json.dumps(worse))
+    report = track(load_points([str(hp), str(wp)]), threshold_pct=5.0)
+    assert report["metrics"][head["metric"]]["serving_regressed"]
+    assert not report["ok"]

@@ -217,6 +217,7 @@ def test_generate_refuses_prequantized_tree_in_fp_modes():
             generate(lm, wq, prompt, steps=2, quant=q)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 11): the 27s training loop dominates; wo-greedy parity stays pinned in-budget by tests/test_serve.py::test_paged_greedy_bit_identical_int8_wo (wo greedy bit-equal across decode paths), test_wo_decode_params_are_int8_resident (int8-resident program) and test_quant_forward_tracks_bf16_forward (wo numerics)
 def test_wo_decode_matches_bf16_greedy_on_trained_model():
     """Train the tiny LM on the affine rule, then weight-only int8 decode
     (cached AND full-recompute) must reproduce the bf16 path's greedy
@@ -343,6 +344,7 @@ def test_quant_pp_step_matches_dp(quant, schedule):
             float(jax.device_get(m_dp[k])), rel=1e-5), k
 
 
+@pytest.mark.slow  # tier-1 budget (PR 11): wo x mesh decode smoke; the fp mesh-decode parity pins (test_generate.py::test_mesh_tp_decode_matches_single_device) and the wo decode residency/parity tests above stay in-budget
 def test_wo_sharded_decode_smoke():
     """int8_wo decode under a data-sharded mesh: scale leaves replicate
     (parallel.tp rule) and the program runs end to end."""

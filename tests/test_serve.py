@@ -1,0 +1,316 @@
+"""Continuous-batching serve engine + paged KV cache (engine.serve/kv_cache).
+
+The pins that matter:
+* greedy decode through the paged path is BIT-IDENTICAL to the contiguous
+  flax-cache `generate` (fp32, bf16, int8_wo weights) — the paged cache is
+  an allocator change, never a model change;
+* mixed-length sequences fit a pool the contiguous per-slot allocator
+  provably cannot (the fragmentation win paged caches exist for);
+* continuous batching strictly beats static drain-batching on completed
+  requests per tick AND occupancy at equal slot capacity (deterministic:
+  both numbers are schedule math, not wall clocks);
+* a forced overload sheds new work through SLO-aware admission control,
+  emitting `slo` + rejection events that reach the flight recorder and the
+  Prometheus gauges through the NORMAL sink fan-out (zero new plumbing).
+"""
+
+import itertools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist.engine.generate import generate
+from tpu_dist.engine.kv_cache import PagedKVPool
+from tpu_dist.engine.serve import DecodeRequest, ServeConfig, ServeEngine
+from tpu_dist.models.transformer import tiny_lm
+from tpu_dist.obs.ledger import Ledger, read_ledger
+
+V, L = 64, 32
+
+
+def _lm_and_params(seed=0, **kw):
+    lm = tiny_lm(vocab_size=V, num_layers=2, d_model=64, num_heads=4,
+                 max_len=L, **kw)
+    params = lm.init({"params": jax.random.PRNGKey(seed)},
+                     jnp.zeros((1, L), jnp.int32), train=False)["params"]
+    return lm, params
+
+
+# ---------------------------------------------------------------- pool
+def test_pool_alloc_free_and_high_water():
+    pool = PagedKVPool(num_layers=1, num_pages=8, page_size=4,
+                       num_heads=2, head_dim=8)
+    a = pool.alloc(3)
+    b = pool.alloc(4)
+    assert len(a) == 3 and len(b) == 4 and not (set(a) & set(b))
+    assert pool.alloc(2) is None          # 1 page left: all-or-nothing
+    assert pool.pages_free == 1
+    pool.free(a)
+    assert pool.pages_free == 4
+    assert pool.high_water_used == 7      # the peak, not the current
+    # the trash page exists beyond the allocatable range
+    assert pool.layers()[0].k.shape[0] == 9
+    assert pool.pages_needed(9) == 3
+
+
+def test_pool_validates_flash_needs_int8():
+    with pytest.raises(ValueError, match="flash"):
+        PagedKVPool(1, 8, 4, 2, 8, read="flash")
+
+
+# ------------------------------------------------- bit-identity pins
+def _assert_serve_matches_generate(lm, params, quant="none", n_reqs=2):
+    """Per-request generate (the contiguous cache) vs one serve run over
+    requests of MIXED prompt lengths — every token bitwise equal.
+    ``n_reqs=1`` is the budget-lean variant for the dtype/quant twins
+    (one reference program instead of two; the mixed-length coverage
+    rides the fp32 run)."""
+    prompts = [np.array([1, 9, 17], np.int32),
+               np.array([5], np.int32)][:n_reqs]
+    steps = [10, 12][:n_reqs]
+    refs = [np.asarray(generate(lm, params, jnp.asarray(p[None]), steps=s,
+                                use_cache=True, quant=quant))[0]
+            for p, s in zip(prompts, steps)]
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=2, page_size=8, num_pages=16, quant=quant))
+    comps = eng.run([DecodeRequest(i, p, s)
+                     for i, (p, s) in enumerate(zip(prompts, steps))])
+    assert len(comps) == n_reqs
+    for c in comps:
+        np.testing.assert_array_equal(refs[c.rid], c.tokens)
+
+
+def test_paged_greedy_bit_identical_to_generate():
+    lm, params = _lm_and_params(seed=4)
+    _assert_serve_matches_generate(lm, params)
+
+
+def test_paged_greedy_bit_identical_bf16():
+    lm, params = _lm_and_params(seed=5, dtype=jnp.bfloat16)
+    _assert_serve_matches_generate(lm, params, n_reqs=1)
+
+
+def test_paged_greedy_bit_identical_int8_wo():
+    lm, params = _lm_and_params(seed=6)
+    _assert_serve_matches_generate(lm, params, quant="int8_wo", n_reqs=1)
+
+
+def test_paged_sampling_is_deterministic_given_rng():
+    lm, params = _lm_and_params(seed=7)
+    reqs = lambda: [DecodeRequest(0, np.array([3, 1, 4], np.int32), 8)]
+    cfg = ServeConfig(max_slots=1, page_size=8, num_pages=8,
+                      temperature=0.9)
+    a = ServeEngine(lm, params, cfg,
+                    rng=jax.random.PRNGKey(11)).run(reqs())[0]
+    b = ServeEngine(lm, params, cfg,
+                    rng=jax.random.PRNGKey(11)).run(reqs())[0]
+    c = ServeEngine(lm, params, cfg,
+                    rng=jax.random.PRNGKey(12)).run(reqs())[0]
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+    assert int(a.tokens.max()) < V and int(a.tokens.min()) >= 0
+    assert not np.array_equal(a.tokens, c.tokens)
+
+
+# ------------------------------------------------- int8 KV pages
+def test_int8_kv_exact_and_flash_kernel_agree():
+    """The gathered-int8 exact read (dequant + fp attention) and the
+    Pallas length-masked kernel decode the SAME tokens — the kernel is a
+    bandwidth optimization of the identical math (interpret mode off-TPU,
+    like every Pallas test in this suite)."""
+    lm, params = _lm_and_params(seed=8)
+    req = lambda: [DecodeRequest(0, np.array([1, 9, 17, 25], np.int32), 10)]
+    outs = {}
+    for read in ("exact", "flash"):
+        eng = ServeEngine(lm, params, ServeConfig(
+            max_slots=1, page_size=8, num_pages=8, kv_quant="int8",
+            attn_read=read))
+        outs[read] = eng.run(req())[0].tokens
+        assert int(outs[read].max()) < V
+    np.testing.assert_array_equal(outs["exact"], outs["flash"])
+
+
+# ------------------------------------------------- fragmentation pin
+def test_mixed_lengths_fit_where_contiguous_cannot():
+    """4 concurrent sequences with totals {32, 12, 8, 8} need 15 pages of
+    4; a contiguous max_len-per-slot allocator would preallocate 32. A
+    20-page pool therefore fits the paged layout and provably not the
+    contiguous one — and the run completes with every sequence resident
+    at once."""
+    lm, params = _lm_and_params(seed=9)
+    pool_pages = 20
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=4, page_size=4, num_pages=pool_pages))
+    assert eng.pool.contiguous_pages_needed(4, L) > pool_pages
+    reqs = [DecodeRequest(0, np.arange(16, dtype=np.int32) % V, 16),
+            DecodeRequest(1, np.array([7, 8, 9, 10], np.int32), 8),
+            DecodeRequest(2, np.array([1, 2], np.int32), 6),
+            DecodeRequest(3, np.array([3, 4], np.int32), 6)]
+    comps = eng.run(reqs)
+    assert len(comps) == 4
+    assert {c.rid for c in comps} == {0, 1, 2, 3}
+    # all four were admitted before any finished (truly concurrent)
+    assert eng.pool.high_water_used == 8 + 3 + 2 + 2
+    assert eng.pool.pages_free == pool_pages  # everything reclaimed
+
+
+# ------------------------------------------------- perf pin
+def test_continuous_batching_beats_static_drain():
+    """Equal capacity, same request set: iteration-level refill completes
+    strictly more requests per decode tick at strictly higher occupancy
+    than drain-batching (both numbers are pure schedule arithmetic —
+    deterministic on any machine)."""
+    lm, params = _lm_and_params(seed=10)
+    rng = np.random.default_rng(0)
+    reqs = lambda: [DecodeRequest(
+        i, rng.integers(0, V, (int(rng.integers(2, 8)),)).astype(np.int32),
+        int(rng.integers(2, 20))) for i in range(12)]
+    stats = {}
+    for refill in ("continuous", "drain"):
+        rng = np.random.default_rng(0)   # same trace both modes
+        eng = ServeEngine(lm, params, ServeConfig(
+            max_slots=4, page_size=8, num_pages=64, refill=refill))
+        comps = eng.run(reqs())
+        assert len(comps) == 12
+        stats[refill] = (len(comps) / eng.ticks, eng.occupancy)
+    assert stats["continuous"][0] > stats["drain"][0], stats
+    assert stats["continuous"][1] > stats["drain"][1], stats
+
+
+# ------------------------------------------------- admission + overload
+def test_admission_rejects_impossible_requests():
+    lm, params = _lm_and_params(seed=11)
+    led_records = []
+    ledger = Ledger(None, sinks=(led_records.append,))
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=1, page_size=4, num_pages=4), ledger=ledger)
+    # prompt + max_new beyond max_len
+    assert not eng.submit(DecodeRequest(0, np.arange(30, dtype=np.int32),
+                                        30))
+    # needs more pages than the whole pool (but within max_len)
+    assert not eng.submit(DecodeRequest(1, np.arange(20, dtype=np.int32),
+                                        8))
+    reasons = [r.get("reason") for r in led_records
+               if r["event"] == "admit"]
+    assert reasons == ["too_long", "exceeds_pool"]
+    assert eng.rejected == 2
+
+
+def test_overload_sheds_emits_slo_and_fires_flightrec(tmp_path):
+    """Queue overload: the wait EMA breaches the SLO floor -> one `slo`
+    event (which auto-triggers the flight recorder through the existing
+    sink fan-out), shedding rejects new submits with `slo_shedding`, and
+    the serving gauges land in the metrics registry — all through the
+    standard ledger plumbing, zero serve-specific wiring."""
+    from tpu_dist.obs.flightrec import FlightRecorder
+    from tpu_dist.obs.metrics import MetricsRegistry, metrics_ledger_sink
+
+    lm, params = _lm_and_params(seed=12)
+    path = str(tmp_path / "serve.jsonl")
+    ledger = Ledger(path)
+    reg = MetricsRegistry()
+    ledger.add_sink(metrics_ledger_sink(reg))
+    fr = FlightRecorder(dir=str(tmp_path / "fr"), ledger=ledger,
+                        trace_steps=0)
+    ledger.add_sink(fr.sink)
+    # a virtual clock that leaps 1s per reading: every queued request
+    # accumulates huge waits, so the EMA breaches the 0.5s floor as soon
+    # as min_samples admissions have happened
+    clock = itertools.count()
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=1, page_size=4, num_pages=8, queue_depth_max=3,
+        slo_queue_wait_s=0.5, slo_min_samples=1),
+        ledger=ledger, now_fn=lambda: float(next(clock)))
+    reqs = [DecodeRequest(i, np.array([1, 2, 3], np.int32), 4)
+            for i in range(10)]
+    accepted = [eng.submit(r) for r in reqs]
+    assert not all(accepted)              # queue cap rejected some
+    # step until the wait EMA breaches and shedding engages, then a fresh
+    # submit is rejected for the SLO (not the queue cap)
+    for _ in range(50):
+        eng.step()
+        if eng.shedding:
+            break
+    assert eng.shedding
+    assert not eng.submit(DecodeRequest(99, np.array([1], np.int32), 2))
+    # drain; idle decay then re-arms the breach (hysteresis downswing) —
+    # a transient overload must not shed forever
+    for _ in range(200):
+        eng.step()
+        if not eng.shedding and not eng.queue \
+                and not any(s is not None for s in eng.slots):
+            break
+    assert not eng.shedding
+    assert eng.submit(DecodeRequest(100, np.array([1], np.int32), 2))
+    while eng.queue or any(s is not None for s in eng.slots):
+        eng.step()
+    ledger.close()
+    recs = read_ledger(path)
+    events = [r["event"] for r in recs]
+    assert "slo" in events
+    rejected = [r for r in recs if r["event"] == "admit"
+                and not r["accepted"]]
+    assert {r.get("reason") for r in rejected} >= {"queue_full",
+                                                   "slo_shedding"}
+    diags = [r for r in recs if r["event"] == "diagnosis"]
+    assert diags and diags[0]["reason"] == "slo"
+    assert os.path.isdir(diags[0]["bundle"])
+    # the scrape carries the serving series
+    scrape = reg.render()
+    assert "tpu_dist_serve_queue_depth" in scrape
+    assert "tpu_dist_kv_pages_free" in scrape
+    assert reg.read_value("tpu_dist_serve_rejected_total") >= 2
+    assert reg.read_value("tpu_dist_serve_requests_total") >= 1
+
+
+# ------------------------------------------------- obs + report
+def test_request_events_render_in_ledger_report(tmp_path):
+    lm, params = _lm_and_params(seed=13)
+    path = str(tmp_path / "serve.jsonl")
+    ledger = Ledger(path)
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=2, page_size=8, num_pages=16, kv_event_every=1),
+        ledger=ledger)
+    comps = eng.run([DecodeRequest(i, np.array([1 + i, 5, 9], np.int32), 6)
+                     for i in range(4)])
+    ledger.close()
+    assert len(comps) == 4
+    recs = read_ledger(path)
+    reqs = [r for r in recs if r["event"] == "request"]
+    assert len(reqs) == 4
+    for r in reqs:
+        assert r["finish_ts"] >= r["first_token_ts"] >= r["admit_ts"]
+        assert r["tokens"] == 6
+    from tools.ledger_report import summarize
+
+    summary = summarize(recs, out=lambda s: None)
+    srv = summary["decode"]["serving"]
+    assert srv["completed"] == 4 and srv["rejected"] == 0
+    assert srv["queue_wait_s"]["p50"] is not None
+    assert srv["ttft_s"]["p99"] >= srv["ttft_s"]["p50"]
+    assert 0 < srv["occupancy"] <= 1
+
+
+# ------------------------------------------------- quantize memo (bugfix)
+def test_quantize_for_decode_lru_survives_alternating_trees():
+    """The round-9 memo held ONE entry keyed on leaf identities: a server
+    alternating two live base trees re-quantized on every call. The LRU
+    keyed per (treedef, mode, leaves) must quantize each tree once."""
+    import tpu_dist.ops.quant as quant_mod
+    from tpu_dist.engine.generate import _quantize_for_decode
+
+    lm, params_a = _lm_and_params(seed=14)
+    _, params_b = _lm_and_params(seed=15)
+    calls = []
+    orig = quant_mod.wo_quantize_params
+    quant_mod.wo_quantize_params = lambda p: (calls.append(1), orig(p))[1]
+    try:
+        for _ in range(3):
+            _quantize_for_decode(lm, params_a, "int8_wo")
+            _quantize_for_decode(lm, params_b, "int8_wo")
+    finally:
+        quant_mod.wo_quantize_params = orig
+    assert len(calls) == 2, f"expected one quantization per tree, " \
+                            f"got {len(calls)}"

@@ -56,6 +56,11 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
             continue
         parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
             else (doc if "metric" in doc else None)
+        if parsed is not None and "value" not in parsed \
+                and "kv_cache" in parsed:
+            # decode_bench headline: the kv-cache tok/s IS the value (and
+            # round 11's serving replay block rides the same object)
+            parsed = dict(parsed, value=parsed["kv_cache"])
         if not parsed or "metric" not in parsed or "value" not in parsed:
             out_err(f"bench_track: skipping {path}: no parsed metric "
                     "(failed round or non-bench file)")
@@ -80,6 +85,11 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
         data_s = phases.get("data_s")
         if data_s == 0:
             data_s = None
+        # serving trace replay (decode_bench --trace, round 11+): the
+        # deterministic completed-requests-per-tick is the gated number —
+        # wall req/s rides the same block but carries machine variance
+        serving = (parsed.get("serving")
+                   if isinstance(parsed.get("serving"), dict) else {})
         points.append({
             "metric": parsed["metric"],
             "value": value,
@@ -87,6 +97,7 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
             "mfu": parsed.get("mfu"),
             "vs_baseline": parsed.get("vs_baseline"),
             "data_s": data_s,
+            "serving_rpt": serving.get("requests_per_tick"),
             "round": rnd,
             "file": os.path.basename(path),
         })
@@ -129,6 +140,17 @@ def track(points: List[dict], threshold_pct: float,
         data_regressed = (data_best is not None
                           and latest.get("data_s") is not None
                           and latest["data_s"] > data_best + data_s_slack)
+        # serving throughput-under-load: judged like the headline value
+        # (higher is better, threshold_pct) against the best prior point
+        # that CARRIES a serving block — pre-serving history abstains,
+        # exactly the data_s convention
+        prior_srv = [p["serving_rpt"] for p in prior
+                     if p.get("serving_rpt") is not None]
+        srv_best = max(prior_srv, default=None)
+        srv_latest = latest.get("serving_rpt")
+        srv_regressed = (srv_best is not None and srv_latest is not None
+                         and (srv_best - srv_latest) / srv_best * 100.0
+                         > threshold_pct)
         rounds = [{"round": p["round"], "value": p["value"],
                    "mfu": p["mfu"], "file": p["file"],
                    "data_s": p.get("data_s"),
@@ -143,8 +165,11 @@ def track(points: List[dict], threshold_pct: float,
             "data_s_latest": latest.get("data_s"),
             "data_s_best_prior": data_best,
             "data_s_regressed": data_regressed,
+            "serving_latest": srv_latest,
+            "serving_best_prior": srv_best,
+            "serving_regressed": srv_regressed,
         }
-        if regressed or data_regressed:
+        if regressed or data_regressed or srv_regressed:
             report["ok"] = False
     return report
 
@@ -176,6 +201,17 @@ def render(report: dict, out=print) -> None:
             out(f"  -> data_s {verdict}: latest {m['data_s_latest']:.4f}s "
                 f"vs best prior {m['data_s_best_prior']:.4f}s (slack "
                 f"{report['data_s_slack']:g}s)")
+        if m.get("serving_latest") is not None:
+            if m.get("serving_best_prior") is not None:
+                verdict = ("SERVING REGRESSED" if m["serving_regressed"]
+                           else "ok")
+                out(f"  -> serving {verdict}: latest "
+                    f"{m['serving_latest']:.4f} req/tick vs best prior "
+                    f"{m['serving_best_prior']:.4f} (threshold "
+                    f"{report['threshold_pct']:g}%)")
+            else:
+                out(f"  -> serving: {m['serving_latest']:.4f} req/tick "
+                    "(no prior serving history; nothing to judge)")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -229,7 +265,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         render(report)
     if (args.check or args.headline) and not report["ok"]:
         bad = [k for k, m in report["metrics"].items()
-               if m["regressed"] or m.get("data_s_regressed")]
+               if m["regressed"] or m.get("data_s_regressed")
+               or m.get("serving_regressed")]
         print(f"bench_track: REGRESSION in {bad}", file=sys.stderr)
         return 1
     return 0

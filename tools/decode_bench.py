@@ -16,10 +16,23 @@ request lands as one ``decode`` ledger event, so
 ``tools/ledger_report.py`` renders the same percentiles in its decode
 section.
 
+``--trace N`` switches to REQUEST-TRACE REPLAY through the
+continuous-batching engine (engine.serve + the paged KV cache): N
+requests with seeded Poisson arrivals and mixed prompt/output lengths
+stream through the scheduler, and the SAME trace then replays through
+static batching (drain refill) at equal slot capacity. The headline JSON
+gains a ``serving`` block — completed requests/s (wall AND per-tick, the
+deterministic twin), TTFT and per-output-token latency p50/p99, batch
+occupancy, and the static baseline — making throughput-UNDER-LOAD the
+recorded metric; ``tools/bench_track.py`` gates on it like ``data_s``.
+Arrivals are scheduled in TICK units from a seeded rng, so the schedule
+(and the per-tick numbers) are machine-speed-independent.
+
 Usage:
     python tools/decode_bench.py                         # both paths
     python tools/decode_bench.py --steps 512 --batch 16
     python tools/decode_bench.py --requests 16 --ledger dec.jsonl
+    python tools/decode_bench.py --trace 64 --serve-slots 8
 """
 
 import json
@@ -28,6 +41,119 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pctl_ms(xs, q):
+    """Nearest-rank percentile of a list of seconds, in ms — THE repo
+    percentile (tools/ledger_report._pctl), ms-scaled, so the bench and
+    the report can never disagree on rank convention."""
+    from tools.ledger_report import _pctl
+
+    v = _pctl(sorted(xs), q)
+    return None if v is None else round(v * 1e3, 3)
+
+
+def _drive_trace(eng, arrivals, prompts, outs):
+    """Replay one arrival schedule through a ServeEngine: requests are
+    submitted when the WALL tick (loop iteration) reaches their arrival
+    tick — idle iterations cost nothing, so the schedule stays
+    deterministic whatever the machine speed. Returns (completions,
+    elapsed_wall_s)."""
+    import time as _t
+
+    from tpu_dist.engine.serve import DecodeRequest
+
+    n = len(prompts)
+    i = 0
+    wall_tick = 0
+    comps = []
+    t0 = _t.perf_counter()
+    while i < n or eng.queue or any(s is not None for s in eng.slots):
+        while i < n and arrivals[i] <= wall_tick:
+            eng.submit(DecodeRequest(i, prompts[i], int(outs[i])))
+            i += 1
+        comps.extend(eng.step())
+        wall_tick += 1
+        if wall_tick > 1_000_000:
+            raise RuntimeError("trace replay did not drain")
+    return comps, _t.perf_counter() - t0
+
+
+def replay_serving_trace(args, model, params, ledger=None):
+    """--trace: the throughput-under-load benchmark. One seeded trace
+    (Poisson arrivals in tick units, mixed prompt/output lengths) replays
+    through continuous batching AND through static drain-batching at equal
+    slot capacity; the returned dict is the headline's ``serving`` block.
+    A warm pass (full replay, discarded) pays the prefill-bucket and tick
+    compiles so both timed modes run warm."""
+    import numpy as np
+
+    from tpu_dist.engine.serve import ServeConfig, ServeEngine
+
+    rng = np.random.default_rng(args.trace_seed)
+    gaps = rng.exponential(1.0 / max(args.arrival_rate, 1e-9), args.trace)
+    arrivals = np.floor(np.cumsum(gaps)).astype(int)
+    prompts = [rng.integers(0, args.vocab_size,
+                            (int(rng.integers(args.min_prompt,
+                                              args.max_prompt + 1)),)
+                            ).astype(np.int32)
+               for _ in range(args.trace)]
+    outs = rng.integers(args.min_out, args.max_out + 1, args.trace)
+    max_total = args.max_prompt + args.max_out
+    pages_per_seq = -(-max_total // args.page_size)
+    num_pages = args.num_pages or args.serve_slots * pages_per_seq
+
+    def make(refill, led=None):
+        return ServeEngine(model, params, ServeConfig(
+            max_slots=args.serve_slots, page_size=args.page_size,
+            num_pages=num_pages, max_len=max_total,
+            quant=args.serve_quant, kv_quant=args.kv_quant,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, refill=refill,
+            kv_event_every=32), ledger=led)
+
+    _drive_trace(make("continuous"), arrivals, prompts, outs)  # warm
+    results = {}
+    for refill in ("continuous", "drain"):
+        eng = make(refill, led=ledger if refill == "continuous" else None)
+        comps, elapsed = _drive_trace(eng, arrivals, prompts, outs)
+        ttft = [c.ttft_s for c in comps]
+        tpot = [(c.finish_ts - c.first_token_ts) / (c.n_generated - 1)
+                for c in comps if c.n_generated > 1]
+        waits = [c.queue_wait_s for c in comps]
+        toks = sum(c.n_generated for c in comps)
+        results[refill] = {
+            "completed": len(comps), "rejected": eng.rejected,
+            "ticks": eng.ticks,
+            "requests_per_tick": (round(len(comps) / eng.ticks, 4)
+                                  if eng.ticks else None),
+            "requests_per_sec": (round(len(comps) / elapsed, 2)
+                                 if elapsed else None),
+            "tokens_per_sec": (round(toks / elapsed, 1)
+                               if elapsed else None),
+            "occupancy": round(eng.occupancy, 4),
+            "ttft_ms": {"p50": _pctl_ms(ttft, 50),
+                        "p99": _pctl_ms(ttft, 99)},
+            "tpot_ms": {"p50": _pctl_ms(tpot, 50),
+                        "p99": _pctl_ms(tpot, 99)},
+            "queue_wait_ms": {"p50": _pctl_ms(waits, 50),
+                              "p99": _pctl_ms(waits, 99)},
+        }
+        print(f"serve[{refill}]: {len(comps)}/{args.trace} completed in "
+              f"{eng.ticks} ticks ({results[refill]['requests_per_tick']} "
+              f"req/tick, {results[refill]['requests_per_sec']} req/s), "
+              f"occupancy {eng.occupancy * 100:.0f}%, TTFT p50 "
+              f"{results[refill]['ttft_ms']['p50']}ms", file=sys.stderr)
+    serving = dict(results["continuous"])
+    serving["requests"] = args.trace
+    serving["slots"] = args.serve_slots
+    serving["page_size"] = args.page_size
+    serving["num_pages"] = num_pages
+    serving["kv_quant"] = args.kv_quant
+    serving["arrival_rate"] = args.arrival_rate
+    serving["trace_seed"] = args.trace_seed
+    serving["static"] = results["drain"]
+    return serving
 
 
 def main():
@@ -68,6 +194,32 @@ def main():
     ap.add_argument("--ledger", default=os.environ.get("BENCH_LEDGER", ""),
                     help="JSONL run ledger: one 'decode' event per request "
                          "(tools/ledger_report.py renders p50/p99 from it)")
+    ap.add_argument("--trace", type=int, default=0,
+                    help="request-trace replay through the continuous-"
+                         "batching engine (engine.serve): this many "
+                         "requests with seeded Poisson arrivals and mixed "
+                         "lengths, plus a static-batching baseline at "
+                         "equal capacity; adds the 'serving' block to the "
+                         "headline JSON (0 = off)")
+    ap.add_argument("--trace-seed", type=int, default=0)
+    ap.add_argument("--arrival-rate", type=float, default=1.0,
+                    help="mean request arrivals per decode tick (Poisson)")
+    ap.add_argument("--min-prompt", type=int, default=4)
+    ap.add_argument("--max-prompt", type=int, default=32)
+    ap.add_argument("--min-out", type=int, default=4)
+    ap.add_argument("--max-out", type=int, default=64)
+    ap.add_argument("--serve-slots", type=int, default=4)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--num-pages", type=int, default=0,
+                    help="paged KV pool size (0 = auto: slots x pages for "
+                         "the worst-case sequence)")
+    ap.add_argument("--kv-quant", default="none", choices=["none", "int8"],
+                    help="page arenas int8+scales (the PR 9 quantize_kv "
+                         "layout) instead of the model dtype")
+    ap.add_argument("--serve-quant", default="none",
+                    choices=["none", "int8", "int8_wo"],
+                    help="weight quant for the serving engine "
+                         "(engine.generate._quantize_for_decode)")
     args = ap.parse_args()
 
     import jax
@@ -92,18 +244,26 @@ def main():
     from tpu_dist.models.transformer import TransformerLM
 
     total = args.prompt_len + args.steps
+    # the pos_emb table must cover the longest sequence either mode runs:
+    # the one-shot geometry AND the trace replay's worst case
+    max_len = max(total, (args.max_prompt + args.max_out) if args.trace
+                  else 0)
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
     if args.num_experts:
         from tpu_dist.models.moe import MoETransformerLM
+        if args.trace:
+            raise SystemExit("--trace serves the dense TransformerLM "
+                             "(engine.serve has no MoE scheduling story "
+                             "yet, ROADMAP item 4)")
         model = MoETransformerLM(
             vocab_size=args.vocab_size, num_layers=args.num_layers,
-            d_model=args.d_model, num_heads=args.num_heads, max_len=total,
+            d_model=args.d_model, num_heads=args.num_heads, max_len=max_len,
             num_experts=args.num_experts,
             capacity_factor=args.capacity_factor, dtype=dtype)
     else:
         model = TransformerLM(
             vocab_size=args.vocab_size, num_layers=args.num_layers,
-            d_model=args.d_model, num_heads=args.num_heads, max_len=total,
+            d_model=args.d_model, num_heads=args.num_heads, max_len=max_len,
             dtype=dtype)
     params = model.init({"params": jax.random.PRNGKey(0)},
                         np.zeros((1, 16), np.int32), train=False)["params"]
@@ -210,6 +370,11 @@ def main():
         print(f"requests: {len(lat)} sequential kv-cache calls, "
               f"{req_tok_s:,.0f} tok/s; latency p50 {latency['p50_ms']:.1f}"
               f"ms / p99 {latency['p99_ms']:.1f}ms", file=sys.stderr)
+    # -- request-trace replay (continuous batching vs static, engine.serve)
+    serving = None
+    if args.trace > 0:
+        serving = replay_serving_trace(args, model, params, ledger=ledger)
+
     if ledger is not None:
         ledger.emit("run_end", steps=args.requests,
                     seconds=round(sum(lat), 3) if latency else 0.0)
@@ -230,6 +395,7 @@ def main():
         "requests": args.requests or None,
         "latency_ms": latency,
         "request_tokens_per_sec": req_tok_s,
+        "serving": serving,
         "ledger": args.ledger or None,
     }))
 

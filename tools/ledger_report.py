@@ -265,22 +265,60 @@ def restarts_section(records, out=print, crash_loop_k=3):
 
 def decode_section(records, out=print):
     """The serving-SLO section: per-request latency percentiles and tok/s
-    over the `decode` events (engine.generate / tools/decode_bench)."""
+    over the `decode` events (engine.generate / tools/decode_bench), plus
+    the continuous-batching view over `request`/`admit`/`kv_cache` events
+    (engine.serve): queue-wait and TTFT percentiles, admission rejections,
+    and batch occupancy from the pool-pressure snapshots."""
     decodes = [r for r in records if r["event"] == "decode"]
-    if not decodes:
+    requests = [r for r in records if r["event"] == "request"]
+    admits = [r for r in records if r["event"] == "admit"]
+    kv = [r for r in records if r["event"] == "kv_cache"]
+    if not decodes and not requests and not admits:
         return None
-    secs = sorted(r["seconds"] for r in decodes
-                  if r.get("seconds") is not None)
-    toks = sum(r.get("tokens") or 0 for r in decodes)
-    total_s = sum(secs)
-    p50, p99 = _pctl(secs, 50), _pctl(secs, 99)
-    d = {"requests": len(decodes), "tokens": toks,
-         "tokens_per_sec": round(toks / total_s, 1) if total_s else None,
-         "latency_s": {"p50": p50, "p99": p99}}
-    out(f"\ndecode: {d['requests']} request(s), {_si(toks, 'tok')}"
-        + (f", {d['tokens_per_sec']:,.0f} tok/s" if total_s else "")
-        + (f"; latency p50 {p50 * 1e3:.1f}ms / p99 {p99 * 1e3:.1f}ms"
-           if p50 is not None else ""))
+    d = {}
+    if decodes:
+        secs = sorted(r["seconds"] for r in decodes
+                      if r.get("seconds") is not None)
+        toks = sum(r.get("tokens") or 0 for r in decodes)
+        total_s = sum(secs)
+        p50, p99 = _pctl(secs, 50), _pctl(secs, 99)
+        d = {"requests": len(decodes), "tokens": toks,
+             "tokens_per_sec": round(toks / total_s, 1) if total_s else None,
+             "latency_s": {"p50": p50, "p99": p99}}
+        out(f"\ndecode: {d['requests']} request(s), {_si(toks, 'tok')}"
+            + (f", {d['tokens_per_sec']:,.0f} tok/s" if total_s else "")
+            + (f"; latency p50 {p50 * 1e3:.1f}ms / p99 {p99 * 1e3:.1f}ms"
+               if p50 is not None else ""))
+    if requests or admits:
+        waits = sorted(r["queue_wait_s"] for r in requests
+                       if r.get("queue_wait_s") is not None)
+        ttfts = sorted(r["ttft_s"] for r in requests
+                       if r.get("ttft_s") is not None)
+        toks = sum(r.get("tokens") or 0 for r in requests)
+        rejected = sum(1 for r in admits if not r.get("accepted"))
+        srv = {"completed": len(requests), "tokens": toks,
+               "rejected": rejected,
+               "queue_wait_s": {"p50": _pctl(waits, 50),
+                                "p99": _pctl(waits, 99)},
+               "ttft_s": {"p50": _pctl(ttfts, 50), "p99": _pctl(ttfts, 99)}}
+        if kv:
+            # occupancy from the pool snapshots: active slots over capacity
+            occ = [r["active_seqs"] / r["slots"] for r in kv
+                   if r.get("active_seqs") is not None and r.get("slots")]
+            srv["occupancy"] = round(_mean(occ), 4) if occ else None
+            last = kv[-1]
+            srv["pages_free_last"] = last.get("pages_free")
+            srv["high_water_used"] = last.get("high_water_used")
+        d["serving"] = srv
+        out(f"\nserving: {srv['completed']} completed, {rejected} rejected"
+            + (f", occupancy {srv['occupancy'] * 100:.0f}%"
+               if srv.get("occupancy") is not None else "")
+            + (f"; queue wait p50 {srv['queue_wait_s']['p50'] * 1e3:.1f}ms"
+               f" / p99 {srv['queue_wait_s']['p99'] * 1e3:.1f}ms"
+               if waits else "")
+            + (f"; TTFT p50 {srv['ttft_s']['p50'] * 1e3:.1f}ms"
+               f" / p99 {srv['ttft_s']['p99'] * 1e3:.1f}ms"
+               if ttfts else ""))
     return d
 
 

@@ -13,12 +13,22 @@ sample from the model they trained. TPU-first constraints shape the design:
   the obvious extension and slots behind the same signature;
 * works with any attn_fn flavor and any mesh placement the params carry
   (replicated for decode is the normal case).
+
+This module is the ONE-SHOT batch call; the serving layer
+(``engine.serve`` + ``engine.kv_cache``) runs the same model under
+continuous batching with a paged KV cache, sharing this module's sampling
+(:func:`_sample`) and weight-quantization (:func:`_quantize_for_decode`)
+helpers — the contiguous flax-cache program here is the single-request
+degenerate case of that paged path, and greedy tokens are bit-identical
+across the two (tests/test_serve.py).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 import weakref
+from collections import OrderedDict
 from functools import lru_cache
 from typing import Optional
 
@@ -185,17 +195,15 @@ def _quantize_for_decode(model, params, quant: str):
     decode tick is weight-bandwidth-bound, so halving the weight bytes is
     THE quant win here. Cloned modules hash by field value, so the memoized
     decode programs still cache-hit across generate() calls — and the
-    quantized TREE is memoized too (single entry, keyed WEAKLY on the fp
-    tree's leaf identities), so a sampling loop calling generate()
-    repeatedly with the same params quantizes once, not per call. The memo
-    holds no strong reference to the fp tree, and self-clears when any fp
-    leaf is collected (the caller dropped the tree), so neither copy is
-    pinned past its natural lifetime. Callers juggling several live trees
-    should pre-quantize themselves (wo_quantize_params) and pass the
-    quantized tree in."""
+    quantized TREE is memoized too: a small LRU keyed on (treedef, mode,
+    fp-leaf identities), so a long-lived serving process alternating
+    between quant modes or between several live base trees (engine.serve
+    keeps one per deployed model) never re-quantizes a live tree — the
+    round-10 single-entry memo thrashed on exactly that alternation. Each
+    entry holds only weakrefs to its fp leaves and self-evicts when any is
+    collected, so neither tree copy is pinned past its natural lifetime."""
     from tpu_dist.ops.quant import (params_are_wo_quantized, validate_quant,
                                     wo_quantize_params)
-    global _wo_memo
 
     validate_quant(quant)
     _refuse_wo_tree(quant, params)
@@ -207,26 +215,51 @@ def _quantize_for_decode(model, params, quant: str):
         model = model.clone(quant=quant)
     if quant == "int8_wo" and not params_are_wo_quantized(params):
         leaves, treedef = jax.tree_util.tree_flatten(params)
-        m = _wo_memo
-        if (m and m[0] == treedef and len(m[1]) == len(leaves)
-                and all(r() is l for r, l in zip(m[1], leaves))):
-            params = m[2]
-        else:
-            quantized = wo_quantize_params(params)
+        # id()s make the key hashable; the stored weakrefs then verify the
+        # leaves are genuinely the same objects (an id can be recycled
+        # after gc — the eviction callback removes the entry first, but
+        # the identity check makes a lost race a re-quantize, never a
+        # wrong-tree hit)
+        key = (treedef, quant, tuple(id(l) for l in leaves))
+        with _wo_memo_lock:
+            hit = _wo_memo.get(key)
+            if (hit is not None
+                    and all(r() is l for r, l in zip(hit[0], leaves))):
+                _wo_memo.move_to_end(key)
+                return model, hit[1]
+        quantized = wo_quantize_params(params)
 
-            def _evict(_ref):  # a fp leaf died: the caller dropped the tree
-                global _wo_memo
-                _wo_memo = None
+        def _evict(_ref, _key=key):  # a fp leaf died: drop its entry
+            with _wo_memo_lock:
+                _wo_memo.pop(_key, None)
 
-            _wo_memo = (treedef,
-                        tuple(weakref.ref(l, _evict) for l in leaves),
-                        quantized)
-            params = quantized
+        # evicted entries are DESTROYED outside the lock: dropping a
+        # quantized tree can trigger gc, gc can fire another entry's
+        # weakref _evict on this same thread, and _evict takes the lock —
+        # an RLock makes the re-entry safe and the deferred del keeps the
+        # critical section free of arbitrary destructor work (the DL101
+        # hazard class, in allocator form)
+        evicted = []
+        with _wo_memo_lock:
+            _wo_memo[key] = (tuple(weakref.ref(l, _evict) for l in leaves),
+                             quantized)
+            _wo_memo.move_to_end(key)
+            while len(_wo_memo) > _WO_MEMO_MAX:
+                evicted.append(_wo_memo.popitem(last=False))
+        del evicted
+        params = quantized
     return model, params
 
 
-_wo_memo = None  # (treedef, leaf weakrefs, wo-quantized tree): the
-                 # single-entry cache of _quantize_for_decode
+# (treedef, mode, leaf ids) -> (leaf weakrefs, quantized tree): the small
+# LRU of _quantize_for_decode. A serving process keeps a handful of live
+# base trees at most; beyond that the caller should pre-quantize
+# (wo_quantize_params) and pass the quantized tree in.
+_WO_MEMO_MAX = 4
+_wo_memo: "OrderedDict" = OrderedDict()
+# RLock, not Lock: gc may run a weakref _evict on the thread that already
+# holds the lock (see the eviction note in _quantize_for_decode)
+_wo_memo_lock = threading.RLock()
 
 
 def _shard_decode_inputs(model, mesh: Mesh, params, buf, rng):

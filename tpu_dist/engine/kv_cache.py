@@ -1,0 +1,135 @@
+"""Paged KV cache pool: one arena per layer, a free-list, block tables.
+
+The contiguous cache ``engine.generate`` allocates is sized ``(B, prompt +
+steps)`` per call — fine for one batch, fatal for a server: N concurrent
+sequences of mixed length would each reserve ``max_len`` rows of HBM whether
+they use 4 or 4000, and finished sequences leave holes no later request
+fits. The paged pool (vLLM's PagedAttention memory model, SOSP '23) fixes
+both: K/V rows live in ONE preallocated ``[num_pages, page_size, heads,
+head_dim]`` arena per layer, each sequence owns an ordered block table of
+page indices, and allocation/eviction are O(pages) free-list ops — HBM
+utilization follows *actual* lengths, and there is no fragmentation to
+compact because every page is interchangeable.
+
+Division of labor: the device-side scatter/gather/attention programs live
+in ``ops.paged_attention`` (this module only *holds* arrays and page
+bookkeeping); the request scheduler that drives both lives in
+``engine.serve``. Arenas ride ``ops.paged_attention.PagedLayer`` packs —
+int8 mode stores pages as int8 with per-(slot, head) fp32 scales (the
+``quantize_kv`` layout, PR 9), halving the HBM the decode tick is
+bandwidth-bound by; ``read='flash'`` additionally routes the tick's reads
+through the int8-KV Pallas kernel.
+
+The allocator is HOST-side state (plain Python ints): page grants happen
+at admission time on the scheduler thread, never inside a jitted program —
+the device programs only ever see block tables as arrays.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from tpu_dist.ops.paged_attention import PagedLayer, pages_for
+
+
+class PagedKVPool:
+    """Preallocated paged KV arenas + the free-list allocator.
+
+    ``num_pages`` is the real capacity; arenas carry one extra *trash* page
+    (index ``num_pages``) that masked writes are routed to, so the jitted
+    scatter needs no branches. ``alloc`` returns page indices or ``None``
+    when the pool cannot satisfy the request — admission control's signal
+    to queue (never a partial grant). ``high_water_used`` tracks the peak
+    concurrent page usage for the ``kv_cache`` ledger event.
+
+    A contiguous allocator serving the same ``max_len``-capable slots would
+    need ``slots * pages_for(max_len, page_size)`` pages up front; the pool
+    needs only the sum of live sequences' ACTUAL pages — the fragmentation
+    pin in tests/test_serve.py runs mixed-length traffic through a pool the
+    contiguous layout provably cannot fit.
+    """
+
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 num_heads: int, head_dim: int, dtype=jnp.float32,
+                 kv_quant: str = "none", read: str = "exact"):
+        if kv_quant not in ("none", "int8"):
+            raise ValueError(f"kv_quant must be 'none' or 'int8', "
+                             f"got {kv_quant!r}")
+        if read not in ("exact", "flash"):
+            raise ValueError(f"read must be 'exact' or 'flash', "
+                             f"got {read!r}")
+        if read == "flash" and kv_quant != "int8":
+            raise ValueError("read='flash' is the int8-KV kernel path; "
+                             "pass kv_quant='int8' (the fp exact path "
+                             "needs no kernel)")
+        self.num_layers = num_layers
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.kv_quant = kv_quant
+        self.read = read
+        shape = (num_pages + 1, page_size, num_heads, head_dim)
+        sshape = (num_pages + 1, page_size, num_heads)
+        self._layers: List[PagedLayer] = []
+        for _ in range(num_layers):
+            if kv_quant == "int8":
+                self._layers.append(PagedLayer(
+                    jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(sshape, jnp.float32),
+                    jnp.zeros(sshape, jnp.float32),
+                    quant="int8", read=read))
+            else:
+                self._layers.append(PagedLayer(
+                    jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                    quant="none", read=read))
+        # lowest-index-first keeps allocation deterministic run to run
+        self._free: List[int] = list(range(num_pages))
+        self.high_water_used = 0
+
+    # -- allocator --------------------------------------------------------
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_used(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def pages_needed(self, total_tokens: int) -> int:
+        return pages_for(total_tokens, self.page_size)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Grant ``n`` pages (all-or-nothing; None when short)."""
+        if n > len(self._free):
+            return None
+        grant, self._free = self._free[:n], self._free[n:]
+        self.high_water_used = max(self.high_water_used, self.pages_used)
+        return grant
+
+    def free(self, pages: List[int]) -> None:
+        self._free.extend(pages)
+        self._free.sort()
+
+    def contiguous_pages_needed(self, slots: int, max_total: int) -> int:
+        """What a contiguous per-slot allocator would preallocate for the
+        same capacity — the fragmentation comparison baseline."""
+        return slots * self.pages_needed(max_total)
+
+    # -- arena plumbing ---------------------------------------------------
+    def layers(self) -> tuple:
+        """The per-layer ``PagedLayer`` packs, as jit arguments."""
+        return tuple(self._layers)
+
+    def adopt(self, new_layers) -> None:
+        """Store the functionally-updated arenas a jitted program returned
+        (the scheduler calls this after every prefill/tick)."""
+        self._layers = list(new_layers)
+
+    def stats(self) -> dict:
+        return {"pages_free": self.pages_free,
+                "pages_used": self.pages_used,
+                "pages_total": self.num_pages,
+                "page_size": self.page_size,
+                "high_water_used": self.high_water_used,
+                "kv_quant": self.kv_quant}

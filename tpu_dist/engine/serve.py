@@ -1,0 +1,517 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+``engine.generate`` answers "decode this batch"; a server has to answer
+"decode this *stream*": requests arrive at their own times with their own
+prompt/output lengths, and the offline pattern — admit a fixed batch, run
+it to the longest sequence's completion, repeat — leaves most slots idle
+most of the time. This module implements Orca-style iteration-level
+scheduling [OSDI '22]: admission decisions happen at every decode tick, a
+finished sequence's slot and pages are reclaimed and refilled the same
+tick, and the headline metric becomes throughput-under-load (completed
+requests/s at a latency SLO), not offline tok/s.
+
+Composition (each piece usable alone):
+
+* :class:`tpu_dist.engine.kv_cache.PagedKVPool` backs every sequence with
+  block-table pages (bf16/fp32, or int8+scales via the PR 9 ``quantize_kv``
+  convention) — mixed-length sequences share HBM without fragmentation;
+* two jitted programs serve all traffic: ``prefill`` (one admit's prompt,
+  padded to a length bucket, writing its pages and sampling the first
+  token) and ``decode_tick`` (the packed slot set, one token per active
+  sequence, per-slot positions — inactive slots ride along masked to the
+  pool's trash page, so the program never re-specializes on occupancy);
+* admission control is SLO-aware: hard queue-depth and free-page
+  watermarks reject at submit time, and an EMA of queue wait (the
+  ``GoodputMonitor`` hysteresis pattern) sheds new work while the backlog
+  breaches the floor — emitting the standard ``slo`` ledger event, which
+  auto-triggers the flight recorder through the existing sink fan-out;
+* every request lands in the ledger (``admit``/``request`` events), pool
+  pressure in periodic ``kv_cache`` events, and the metrics sink exports
+  ``tpu_dist_serve_queue_depth`` / ``tpu_dist_serve_active_seqs`` /
+  ``tpu_dist_kv_pages_free`` gauges — scrape-able on day one.
+
+Sampling and weight quantization are SHARED with ``engine.generate``
+(:func:`~tpu_dist.engine.generate._sample`,
+:func:`~tpu_dist.engine.generate._quantize_for_decode`): the one-shot
+contiguous-cache call is the single-request degenerate case of this path,
+and greedy tokens are bit-identical across the two (tests/test_serve.py).
+
+The scheduler itself is host-side and clock-agnostic: ``now_fn`` defaults
+to ``time.monotonic``, and tests/trace replay pass a virtual clock for
+fully deterministic runs (the ROADMAP's million-user-on-CPU direction).
+Multi-host/mesh serving is future work — params stay wherever the caller
+put them (single-process serving is the shape this PR pins down).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from functools import lru_cache, partial
+from typing import Callable, Deque, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist.engine.generate import (_quantize_for_decode, _refuse_wo_tree,
+                                      _sample)
+from tpu_dist.engine.kv_cache import PagedKVPool
+
+
+@dataclass
+class DecodeRequest:
+    """One generation request: continue ``prompt`` by ``max_new_tokens``
+    (or until ``ServeConfig.eos_id``). ``rid`` is the caller's correlation
+    id — it rides every ledger event this request produces."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+
+
+@dataclass
+class Completion:
+    """A finished request with its serving timeline (engine-clock
+    timestamps: real seconds under the default clock, virtual units under
+    an injected one)."""
+
+    rid: int
+    tokens: np.ndarray           # (prompt + generated,) int32
+    prompt_len: int
+    n_generated: int
+    admit_ts: float              # entered the queue (submit time)
+    start_ts: float              # left the queue (prefill start)
+    first_token_ts: float
+    finish_ts: float
+
+    @property
+    def queue_wait_s(self) -> float:
+        return self.start_ts - self.admit_ts
+
+    @property
+    def ttft_s(self) -> float:
+        return self.first_token_ts - self.admit_ts
+
+
+@dataclass
+class ServeConfig:
+    """Scheduler + paged-cache knobs (README "Serving" has the tour)."""
+
+    max_slots: int = 4           # concurrent sequences (the packed batch)
+    page_size: int = 16          # tokens per KV page
+    num_pages: int = 256         # pool capacity (per layer, +1 trash page)
+    max_len: Optional[int] = None   # per-sequence cap (default model.max_len)
+    quant: str = "none"          # weight quant (int8_wo pre-quantizes once)
+    kv_quant: str = "none"       # page dtype: none (model dtype) | int8
+    attn_read: str = "exact"     # exact | flash (int8-KV Pallas kernel)
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    eos_id: Optional[int] = None
+    prefill_buckets: Tuple[int, ...] = ()   # () = powers of 2 up to max_len
+    refill: str = "continuous"   # continuous | drain (static-batch baseline)
+    queue_depth_max: int = 64    # hard admission cap
+    free_page_watermark: float = 0.0   # reject below this free fraction
+    slo_queue_wait_s: float = 0.0      # EMA floor; 0 disables shedding
+    slo_alpha: float = 0.5
+    slo_min_samples: int = 2
+    kv_event_every: int = 0      # ticks between kv_cache events (0 = final)
+
+
+@dataclass
+class _Slot:
+    req: DecodeRequest
+    pages: List[int]
+    block_table: np.ndarray      # (max_pages_per_seq,) int32
+    buf: np.ndarray              # (prompt + max_new,) int32
+    prompt_len: int
+    admit_ts: float
+    start_ts: float
+    position: int = 0            # next KV write position
+    generated: int = 0
+    first_token_ts: float = 0.0
+    finish_ts: float = 0.0
+    done: bool = False
+
+
+def _default_buckets(max_len: int) -> Tuple[int, ...]:
+    """Powers of two up to max_len (plus max_len itself): each bucket is
+    one compiled prefill geometry, so a handful covers every prompt."""
+    out = []
+    b = 8
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+# The compiled serving programs are memoized per (model, sampling)
+# signature — jit itself re-specializes per shape (prefill buckets, slot
+# count), so one entry serves every geometry of one deployment. Same
+# rationale as engine.generate's program caches.
+
+@lru_cache(maxsize=32)
+def _prefill_program(model, temperature, top_k, top_p):
+    # the arenas are DONATED: the caller (the pool) adopts the returned
+    # ones and never touches the old buffers again, and without aliasing
+    # every call would copy every layer's whole page arena — per admitted
+    # prompt, in the feature that exists to keep KV HBM tight
+    @partial(jax.jit, donate_argnums=(1,))
+    def prefill(params, layers, block_table, length, prompt, rng):
+        # block_table (1, max_pages) i32, length () i32, prompt (1, bucket):
+        # causal self-attention over the padded prompt (positions >= length
+        # influence nothing earlier), pages written for the live prefix,
+        # first token sampled from the last LIVE row's logits
+        paged = {"layers": layers, "block_tables": block_table,
+                 "positions": jnp.zeros((1,), jnp.int32),
+                 "lengths": jnp.asarray(length, jnp.int32)[None]}
+        logits, new_layers = model.apply(
+            {"params": params}, prompt, train=False,
+            paged=paged, paged_prefill=True)
+        last = jnp.take_along_axis(
+            logits, jnp.reshape(length - 1, (1, 1, 1)).astype(jnp.int32),
+            axis=1)[:, 0]
+        nxt, rng = _sample(last, temperature, rng, top_k, top_p)
+        return nxt[0].astype(jnp.int32), new_layers, rng
+
+    return prefill
+
+
+@lru_cache(maxsize=32)
+def _tick_program(model, temperature, top_k, top_p):
+    # arenas donated for the same reason as _prefill_program: the tick
+    # writes one row per slot and the un-aliased alternative is a full
+    # arena copy per generated token
+    @partial(jax.jit, donate_argnums=(1,))
+    def tick(params, layers, block_tables, tokens, positions, rng):
+        # one token per slot at its OWN position; inactive slots carry
+        # all-trash block tables and position 0, so their writes land on
+        # the trash page and their (ignored) logits cost one lane of the
+        # same program — occupancy changes never retrace
+        paged = {"layers": layers, "block_tables": block_tables,
+                 "positions": positions, "lengths": positions + 1}
+        logits, new_layers = model.apply(
+            {"params": params}, tokens[:, None], train=False,
+            pos_offset=positions, paged=paged)
+        nxt, rng = _sample(logits[:, 0], temperature, rng, top_k, top_p)
+        return nxt.astype(jnp.int32), new_layers, rng
+
+    return tick
+
+
+class ServeEngine:
+    """The continuous-batching scheduler (module docstring has the tour).
+
+    Drive it either with :meth:`run` (submit everything, drain — the test
+    and bit-identity shape) or manually: ``submit()`` as requests arrive,
+    ``step()`` once per scheduler iteration (evict -> admit+prefill ->
+    decode tick), each returning the requests that finished.
+    """
+
+    def __init__(self, model, params, config: Optional[ServeConfig] = None,
+                 *, ledger=None, now_fn: Callable[[], float] = time.monotonic,
+                 rng: Optional[jax.Array] = None):
+        config = config if config is not None else ServeConfig()
+        if getattr(model, "num_experts", 0):
+            raise NotImplementedError(
+                "paged serving covers the dense TransformerLM family; the "
+                "MoE capacity-factor dispatch needs its own scheduling "
+                "story (ROADMAP item 4)")
+        cfg = config
+        if cfg.quant != "none":
+            model, params = _quantize_for_decode(model, params, cfg.quant)
+        else:
+            _refuse_wo_tree(getattr(model, "quant", "none"), params)
+        self.model = model
+        self.params = params
+        self.cfg = cfg
+        self.max_len = min(cfg.max_len or model.max_len, model.max_len)
+        head_dim = model.d_model // model.num_heads
+        self.pool = PagedKVPool(
+            model.num_layers, cfg.num_pages, cfg.page_size,
+            model.num_heads, head_dim, dtype=model.dtype,
+            kv_quant=cfg.kv_quant, read=cfg.attn_read)
+        self.max_pages_per_seq = self.pool.pages_needed(self.max_len)
+        # max_len always terminates the bucket ladder: a custom list that
+        # stops short of a legal prompt must widen to max_len, not crash
+        # the admit (and leak its granted pages) on a missing bucket
+        self.buckets = tuple(sorted({self.max_len, *(
+            b for b in (cfg.prefill_buckets or _default_buckets(self.max_len))
+            if b <= self.max_len)}))
+        self.slots: List[Optional[_Slot]] = [None] * cfg.max_slots
+        self.queue: Deque[Tuple[DecodeRequest, float]] = deque()
+        self._now = now_fn
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.ledger = ledger
+        # counters / SLO state
+        self.ticks = 0
+        self.completed = 0
+        self.rejected = 0
+        self.prefills = 0
+        self._occupancy_sum = 0.0
+        self._wait_ema: Optional[float] = None
+        self._wait_samples = 0
+        self._in_breach = False
+        self.shedding = False
+        self._last_kv_tick = 0
+
+    # -- admission --------------------------------------------------------
+    def submit(self, req: DecodeRequest) -> bool:
+        """Queue one request; False = rejected by admission control (the
+        caller's signal to back off / retry elsewhere)."""
+        now = self._now()
+        prompt_len = int(np.asarray(req.prompt).size)
+        total = prompt_len + req.max_new_tokens
+        if prompt_len < 1 or req.max_new_tokens < 1 or total > self.max_len:
+            # degenerate geometry (empty prompt, nothing to generate, or
+            # beyond max_len) can never be served — reject at the door
+            # rather than crash a slot after pages were granted
+            self._emit_admit(req, now, False, "too_long")
+            return False
+        if self.pool.pages_needed(total) > self.pool.num_pages:
+            self._emit_admit(req, now, False, "exceeds_pool")
+            return False
+        if self.shedding:
+            self._emit_admit(req, now, False, "slo_shedding")
+            return False
+        if len(self.queue) >= self.cfg.queue_depth_max:
+            self._emit_admit(req, now, False, "queue_full")
+            return False
+        free_frac = self.pool.pages_free / max(self.pool.num_pages, 1)
+        if free_frac < self.cfg.free_page_watermark:
+            self._emit_admit(req, now, False, "page_watermark")
+            return False
+        self.queue.append((req, now))
+        self._emit_admit(req, now, True, None)
+        return True
+
+    def _emit_admit(self, req, now, accepted, reason):
+        if not accepted:
+            self.rejected += 1
+        if self.ledger is None:
+            return
+        self.ledger.emit("admit", rid=req.rid, accepted=accepted,
+                         queue_depth=len(self.queue),
+                         pages_free=self.pool.pages_free,
+                         reason=reason, ts_engine=round(now, 6))
+
+    def _observe_wait(self, wait: float) -> None:
+        a = self.cfg.slo_alpha
+        self._wait_ema = (wait if self._wait_ema is None
+                          else a * wait + (1 - a) * self._wait_ema)
+        self._wait_samples += 1
+        floor = self.cfg.slo_queue_wait_s
+        if floor <= 0 or self._wait_samples < self.cfg.slo_min_samples:
+            return
+        if self._wait_ema > floor and not self._in_breach:
+            self._in_breach = True
+            self.shedding = True
+            if self.ledger is not None:
+                # the standard progress-SLO event: the flight recorder and
+                # the slo-breach counter hang off the normal sink fan-out
+                self.ledger.emit("slo", step=self.ticks, kind="queue_wait",
+                                 value=round(self._wait_ema, 6), floor=floor,
+                                 unit="s")
+        elif self._wait_ema <= floor and self._in_breach:
+            self._in_breach = False   # re-arm; resume admitting
+            self.shedding = False
+
+    def _decay_wait_if_idle(self) -> None:
+        """While shedding with an EMPTY queue, the only wait evidence left
+        is stale — a fresh request would start from a drained backlog. The
+        EMA only updates on admissions, so without this decay a transient
+        overload would shed forever once the queue drained (no admissions
+        -> no observations -> no re-arm). One alpha-decay toward zero per
+        scheduler iteration restores the hysteresis loop's downswing."""
+        if not self.shedding or self.queue or self._wait_ema is None:
+            return
+        self._wait_ema *= (1 - self.cfg.slo_alpha)
+        if self._wait_ema <= self.cfg.slo_queue_wait_s:
+            self._in_breach = False
+            self.shedding = False
+
+    # -- the scheduler iteration -----------------------------------------
+    def step(self) -> List[Completion]:
+        """One iteration: evict finished sequences (freeing their slots
+        and pages), admit + prefill from the queue into the free slots,
+        then run one decode tick over the packed active set. Returns the
+        completions evicted this iteration."""
+        completions = self._evict()
+        self._admit()
+        self._tick()
+        self._decay_wait_if_idle()
+        every = self.cfg.kv_event_every
+        # keyed on DECODE ticks, deduplicated: idle iterations don't
+        # advance the counter and must neither spam one event per loop
+        # nor re-emit the same tick's snapshot
+        if (every > 0 and self.ticks % every == 0
+                and self.ticks != self._last_kv_tick):
+            self._last_kv_tick = self.ticks
+            self._emit_kv_cache()
+        return completions
+
+    def run(self, requests=(), max_ticks: int = 100_000) -> List[Completion]:
+        """Submit everything, then step until drained (tests, batch jobs).
+        Rejected submissions are simply absent from the completions."""
+        for req in requests:
+            self.submit(req)
+        out: List[Completion] = []
+        while self.queue or any(s is not None for s in self.slots):
+            out.extend(self.step())
+            if self.ticks > max_ticks:
+                raise RuntimeError(
+                    f"serve drain exceeded {max_ticks} ticks "
+                    f"({len(self.queue)} queued, "
+                    f"{sum(s is not None for s in self.slots)} active)")
+        self._emit_kv_cache()
+        return out
+
+    # -- internals --------------------------------------------------------
+    def _evict(self) -> List[Completion]:
+        out = []
+        for i, slot in enumerate(self.slots):
+            if slot is None or not slot.done:
+                continue
+            self.pool.free(slot.pages)
+            self.slots[i] = None
+            n = slot.prompt_len + slot.generated
+            comp = Completion(
+                rid=slot.req.rid, tokens=slot.buf[:n].copy(),
+                prompt_len=slot.prompt_len, n_generated=slot.generated,
+                admit_ts=slot.admit_ts, start_ts=slot.start_ts,
+                first_token_ts=slot.first_token_ts,
+                finish_ts=slot.finish_ts)
+            self.completed += 1
+            out.append(comp)
+            if self.ledger is not None:
+                self.ledger.emit(
+                    "request", rid=comp.rid, tokens=comp.n_generated,
+                    queue_wait_s=round(comp.queue_wait_s, 6),
+                    admit_ts=round(comp.admit_ts, 6),
+                    first_token_ts=round(comp.first_token_ts, 6),
+                    finish_ts=round(comp.finish_ts, 6),
+                    prompt_len=comp.prompt_len,
+                    ttft_s=round(comp.ttft_s, 6))
+        return out
+
+    def _admit(self) -> None:
+        if self.cfg.refill == "drain" and any(
+                s is not None for s in self.slots):
+            return  # static batching: refill only once the batch drained
+        for i in range(len(self.slots)):
+            if not self.queue:
+                break
+            if self.slots[i] is not None:
+                continue
+            req, enq_ts = self.queue[0]
+            prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+            total = prompt.size + req.max_new_tokens
+            pages = self.pool.alloc(self.pool.pages_needed(total))
+            if pages is None:
+                break  # pool pressure: leave it queued, decode on
+            self.queue.popleft()
+            now = self._now()
+            self._observe_wait(now - enq_ts)
+            self._prefill(i, req, prompt, pages, enq_ts, now)
+
+    def _prefill(self, slot_idx, req, prompt, pages, enq_ts, start_ts):
+        p = prompt.size
+        bucket = next(b for b in self.buckets if b >= p)
+        bt = np.full((self.max_pages_per_seq,), self.pool.num_pages,
+                     np.int32)                       # unassigned -> trash
+        bt[:len(pages)] = pages
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p] = prompt
+        program = _prefill_program(self.model, self.cfg.temperature,
+                                   self.cfg.top_k, self.cfg.top_p)
+        tok, new_layers, self._rng = program(
+            self.params, self.pool.layers(), jnp.asarray(bt[None]),
+            jnp.int32(p), jnp.asarray(padded), self._rng)
+        self.pool.adopt(new_layers)
+        self.prefills += 1
+        # the scheduler IS the drain boundary: the first token decides
+        # done/eos and the TTFT stamp before the next iteration
+        # distlint: disable=DL002 -- iteration-level scheduling syncs once per admit by design
+        tok = int(jax.device_get(tok))
+        now = self._now()
+        slot = _Slot(req=req, pages=pages, block_table=bt,
+                     buf=np.zeros((p + req.max_new_tokens,), np.int32),
+                     prompt_len=p, admit_ts=enq_ts, start_ts=start_ts,
+                     position=p, generated=1, first_token_ts=now)
+        slot.buf[:p] = prompt
+        slot.buf[p] = tok
+        if (slot.generated >= req.max_new_tokens
+                or tok == self.cfg.eos_id):
+            slot.done = True
+            slot.finish_ts = now
+        self.slots[slot_idx] = slot
+
+    def _tick(self) -> None:
+        active = [(i, s) for i, s in enumerate(self.slots)
+                  if s is not None and not s.done]
+        if not active:
+            return
+        n = len(self.slots)
+        tokens = np.zeros((n,), np.int32)
+        positions = np.zeros((n,), np.int32)
+        bts = np.full((n, self.max_pages_per_seq), self.pool.num_pages,
+                      np.int32)
+        for i, s in active:
+            tokens[i] = s.buf[s.prompt_len + s.generated - 1]
+            positions[i] = s.position
+            bts[i] = s.block_table
+        program = _tick_program(self.model, self.cfg.temperature,
+                                self.cfg.top_k, self.cfg.top_p)
+        nxt, new_layers, self._rng = program(
+            self.params, self.pool.layers(), jnp.asarray(bts),
+            jnp.asarray(tokens), jnp.asarray(positions), self._rng)
+        self.pool.adopt(new_layers)
+        # iteration-level scheduling: every tick's tokens come back to the
+        # host so finished sequences free their slot/pages for the SAME-
+        # tick refill — the one sync per tick is the scheduling primitive,
+        # not an accident (Orca's design point)
+        # distlint: disable=DL002 -- the per-tick sync is the scheduler's eviction/refill decision point
+        nxt = np.asarray(jax.device_get(nxt))
+        now = self._now()
+        for i, s in active:
+            tok = int(nxt[i])
+            s.buf[s.prompt_len + s.generated] = tok
+            s.generated += 1
+            s.position += 1
+            if (s.generated >= s.req.max_new_tokens
+                    or tok == self.cfg.eos_id):
+                s.done = True
+                s.finish_ts = now
+        self.ticks += 1
+        self._occupancy_sum += len(active) / max(len(self.slots), 1)
+
+    def _emit_kv_cache(self) -> None:
+        if self.ledger is None:
+            return
+        st = self.pool.stats()
+        self.ledger.emit("kv_cache", pages_free=st["pages_free"],
+                         pages_used=st["pages_used"],
+                         active_seqs=sum(s is not None for s in self.slots),
+                         pages_total=st["pages_total"],
+                         high_water_used=st["high_water_used"],
+                         slots=len(self.slots), tick=self.ticks)
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def occupancy(self) -> float:
+        """Mean active-slot share across decode ticks — the utilization
+        number that separates continuous from static batching."""
+        return self._occupancy_sum / self.ticks if self.ticks else 0.0
+
+    def stats(self) -> dict:
+        return {"ticks": self.ticks, "completed": self.completed,
+                "rejected": self.rejected, "prefills": self.prefills,
+                "occupancy": round(self.occupancy, 6),
+                "queue_depth": len(self.queue),
+                "active_seqs": sum(s is not None for s in self.slots),
+                "wait_ema_s": self._wait_ema,
+                "shedding": self.shedding,
+                **self.pool.stats()}

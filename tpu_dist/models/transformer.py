@@ -50,7 +50,8 @@ def full_attention(q, k, v, *, causal: bool = True,
 
 
 def attend_maybe_cached(mdl: nn.Module, q, k, v, *, decode: bool,
-                        attn_fn: Callable, dtype) -> jax.Array:
+                        attn_fn: Callable, dtype, paged=None,
+                        paged_prefill: bool = False):
     """Attention contraction, maintaining ``mdl``'s per-block KV cache when
     ``decode`` (the standard flax decode pattern): the cache is allocated
     at init time from the full-length input, then one position is written
@@ -60,7 +61,22 @@ def attend_maybe_cached(mdl: nn.Module, q, k, v, *, decode: bool,
     implementation. Decode always uses exact full attention over the cache:
     the attn_fn plug-in (flash/blockwise/ring) exists for TRAINING-time
     memory, and flash's custom_vjp can't take the traced cache index as its
-    static offset anyway."""
+    static offset anyway.
+
+    ``paged`` (engine.kv_cache / ops.paged_attention) swaps the flax cache
+    for this layer's slice of an EXTERNAL paged KV pool: the pack carries
+    the layer's page arenas plus per-row block tables and positions, so
+    every batch row can sit at its own position — the continuous-batching
+    serving path, where the flax cache's scalar ``cache_index`` is exactly
+    what doesn't work. Returns ``(out, updated_layer)`` in that mode; the
+    flax-cache contiguous path remains the single-batch degenerate case
+    (engine.generate) and is bit-identical on greedy tokens
+    (tests/test_serve.py pins it)."""
+    if paged is not None:
+        from tpu_dist.ops.paged_attention import paged_attend
+
+        return paged_attend(q, k, v, paged, prefill=paged_prefill,
+                            attn_fn=attn_fn, dtype=dtype)
     if not decode:
         return attn_fn(q, k, v)
     is_init = mdl.has_variable("cache", "cached_k")
@@ -95,7 +111,8 @@ class Block(nn.Module):
                             # of the row partials — parallel.overlap)
 
     @nn.compact
-    def __call__(self, x, train: bool = True, decode: bool = False):
+    def __call__(self, x, train: bool = True, decode: bool = False,
+                 paged=None, paged_prefill: bool = False):
         ring = self.tp_impl != "gspmd"
         if ring and decode:
             raise ValueError("tp_impl='ring' is a training path; decode "
@@ -126,7 +143,11 @@ class Block(nn.Module):
         shp = (q.shape[0], q.shape[1], -1, head_dim)  # local heads if ring
         q, k, v = q.reshape(shp), k.reshape(shp), v.reshape(shp)
         out = attend_maybe_cached(self, q, k, v, decode=decode,
-                                  attn_fn=self.attn_fn, dtype=self.dtype)
+                                  attn_fn=self.attn_fn, dtype=self.dtype,
+                                  paged=paged, paged_prefill=paged_prefill)
+        new_layer = None
+        if paged is not None:
+            out, new_layer = out
         out = out.reshape(out.shape[0], out.shape[1], -1)
         x = x + make_dense(d_model, use_bias=False, dtype=self.dtype,
                            name="proj", quant=self.quant,
@@ -137,6 +158,8 @@ class Block(nn.Module):
         h = nn.gelu(h)
         x = x + make_dense(d_model, dtype=self.dtype, name="mlp_out",
                            quant=self.quant, tp_kind="row", **tp)(h)
+        if paged is not None:
+            return x, new_layer
         return x
 
 
@@ -168,20 +191,30 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, pos_offset=0,
-                 decode: bool = False, return_features: bool = False):
+                 decode: bool = False, return_features: bool = False,
+                 paged=None, paged_prefill: bool = False):
         # pos_offset: global position of this shard's first token (sequence
         # parallelism passes axis_index * shard_len, a traced scalar; 0 when
-        # the sequence axis is unsharded). decode=True enables the per-block
-        # KV cache ('cache' collection) for autoregressive generation.
-        # return_features=True skips lm_head and returns the (B, L, D)
-        # post-ln_f features — the chunked-loss path (ops.fused_xent) applies
-        # the head itself, one row-chunk at a time, so the full (B, L, V)
-        # logits never materialize.
+        # the sequence axis is unsharded; the paged serving tick passes a
+        # (B,) vector — every slot sits at its own position). decode=True
+        # enables the per-block KV cache ('cache' collection) for
+        # autoregressive generation; `paged` instead threads an EXTERNAL
+        # paged KV pool through the blocks (engine.kv_cache) and makes the
+        # call return (logits, updated_layers). return_features=True skips
+        # lm_head and returns the (B, L, D) post-ln_f features — the
+        # chunked-loss path (ops.fused_xent) applies the head itself, one
+        # row-chunk at a time, so the full (B, L, V) logits never
+        # materialize.
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                      name="tok_emb")(tokens)
-        pos = pos_offset + jnp.arange(tokens.shape[1])
-        x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
-                         name="pos_emb")(pos)[None]
+        pos_emb = nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
+                           name="pos_emb")
+        off = jnp.asarray(pos_offset)
+        if off.ndim:  # per-row positions: (B,) + (L,) -> (B, L) lookups
+            pos = off[:, None] + jnp.arange(tokens.shape[1])[None, :]
+            x = x + pos_emb(pos)
+        else:
+            x = x + pos_emb(pos_offset + jnp.arange(tokens.shape[1]))[None]
         if self.tp_impl == "ring":
             # enter the seq-sharded ring residual: from here each device
             # carries its (B, L/n, D) chunk; the blocks' column/row ring
@@ -191,21 +224,44 @@ class TransformerLM(nn.Module):
                                  "decode rides the GSPMD layers")
             from tpu_dist.parallel.overlap import seq_shard
             x = seq_shard(x)
-        block_cls = (nn.remat(Block, static_argnums=(2, 3)) if self.remat
-                     else Block)
+        # remat exists for the training backward; the paged serving path
+        # never differentiates, and remat's static_argnums would try to
+        # make the traced `paged` pack static — plain blocks there, always
+        block_cls = (nn.remat(Block, static_argnums=(2, 3))
+                     if self.remat and paged is None else Block)
+        new_layers = []
+        ctx = (None if paged is None else
+               {k: paged[k] for k in ("block_tables", "positions",
+                                      "lengths")})
         for i in range(self.num_layers):
-            x = block_cls(self.num_heads, self.dtype, self.attn_fn,
-                          self.quant, self.tp_impl,
-                          name=f"block{i}")(x, train, decode)
+            blk = block_cls(self.num_heads, self.dtype, self.attn_fn,
+                            self.quant, self.tp_impl, name=f"block{i}")
+            if paged is None:
+                x = blk(x, train, decode)
+            else:
+                x, nl = blk(x, train, decode,
+                            {**ctx, "layer": paged["layers"][i]},
+                            paged_prefill)
+                new_layers.append(nl)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_features:
+            if paged is not None:
+                # the early return would silently DROP the updated arenas
+                # (stale KV on every later tick, no error) — refuse until
+                # a chunked-head serving path actually threads them
+                raise ValueError("return_features=True cannot ride the "
+                                 "paged cache path: the updated page "
+                                 "arenas would be discarded")
             return x
         # the head stays a full local matmul under ring (kernel replicated,
         # rows = this device's seq chunk), so the fp32 softmax/loss math is
         # untouched; parity with GSPMD's vocab-sharded head is exact
         logits = make_dense(self.vocab_size, use_bias=False, dtype=self.dtype,
                             name="lm_head", quant=self.quant)(x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        if paged is not None:
+            return logits, tuple(new_layers)
+        return logits
 
 
 def tiny_lm(vocab_size=256, num_layers=2, d_model=64, num_heads=4,

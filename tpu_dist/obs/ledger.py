@@ -69,6 +69,19 @@ EVENT_SCHEMA = {
     "hbm": ("bytes_in_use",),
     # one generate() call (engine.generate with a ledger passed in)
     "decode": ("tokens", "seconds", "throughput"),
+    # serving admission decision (engine.serve): one per submit();
+    # accepted=False carries a `reason` extra (queue_full|page_watermark|
+    # slo_shedding|too_long|exceeds_pool) — the overload forensics
+    "admit": ("rid", "accepted", "queue_depth", "pages_free"),
+    # one COMPLETED serving request (engine.serve): the serving-SLO
+    # record — timestamps are engine-clock (real seconds by default,
+    # virtual units under an injected clock); ttft_s/prompt_len ride as
+    # extras
+    "request": ("rid", "tokens", "queue_wait_s", "admit_ts",
+                "first_token_ts", "finish_ts"),
+    # paged KV pool pressure snapshot (engine.serve, periodic + final):
+    # high_water_used/slots/tick ride as extras
+    "kv_cache": ("pages_free", "pages_used", "active_seqs"),
     # numerical-health trip (obs.health sentry: non-finite grads/loss or a
     # loss spike); action records what the policy did (record|skip|halt)
     "health": ("step", "kind", "policy", "action", "value"),

@@ -361,12 +361,28 @@ def metrics_ledger_sink(reg: MetricsRegistry):
     hbm = reg.gauge("tpu_dist_hbm_bytes_in_use", "last HBM sampler reading")
     decode_toks = reg.counter("tpu_dist_decode_tokens_total",
                               "tokens produced by generate() calls")
+    # serving (engine.serve): queue/occupancy/pool-pressure gauges track
+    # the admit/kv_cache event stream; requests and admission rejections
+    # are counters so a dashboard rates them
+    serve_queue = reg.gauge("tpu_dist_serve_queue_depth",
+                            "decode requests waiting for a slot")
+    serve_active = reg.gauge("tpu_dist_serve_active_seqs",
+                             "sequences occupying serve slots")
+    kv_free = reg.gauge("tpu_dist_kv_pages_free",
+                        "free pages in the paged KV pool")
+    serve_reqs = reg.counter("tpu_dist_serve_requests_total",
+                             "serving requests completed")
+    serve_rejects = reg.counter("tpu_dist_serve_rejected_total",
+                                "submissions rejected by admission control")
+    serve_toks = reg.counter("tpu_dist_serve_tokens_total",
+                             "tokens generated by the serving engine")
     # materialize the unlabeled children too — a family with no child
     # renders no sample line, and "0" vs "absent" are different answers
     # to "is it hung?"
     for m in (steps, items, mfu, loss, stalls, stall_idle, skew_spread,
               straggler, epoch_g, eval_loss, hbm, decode_toks, step_hist,
-              goodput_ratio):
+              goodput_ratio, serve_queue, serve_active, kv_free, serve_reqs,
+              serve_rejects, serve_toks):
         m.labels()
 
     def sink(rec: dict) -> None:
@@ -431,6 +447,22 @@ def metrics_ledger_sink(reg: MetricsRegistry):
         elif ev == "decode":
             if rec.get("tokens"):
                 decode_toks.inc(rec["tokens"])
+        elif ev == "admit":
+            if rec.get("queue_depth") is not None:
+                serve_queue.set(rec["queue_depth"])
+            if rec.get("pages_free") is not None:
+                kv_free.set(rec["pages_free"])
+            if not rec.get("accepted"):
+                serve_rejects.inc()
+        elif ev == "request":
+            serve_reqs.inc()
+            if rec.get("tokens"):
+                serve_toks.inc(rec["tokens"])
+        elif ev == "kv_cache":
+            if rec.get("pages_free") is not None:
+                kv_free.set(rec["pages_free"])
+            if rec.get("active_seqs") is not None:
+                serve_active.set(rec["active_seqs"])
         elif ev == "goodput":
             if rec.get("ratio") is not None:
                 goodput_ratio.set(rec["ratio"])

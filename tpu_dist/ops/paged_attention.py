@@ -1,0 +1,370 @@
+"""Block-table (paged) KV attention primitives — the serving read/write path.
+
+vLLM's PagedAttention [SOSP '23] observation, TPU-shaped: a contiguous
+per-sequence KV cache sized to the worst-case total fragments HBM the moment
+requests of mixed length share a batch — every slot pays max_len whether it
+decodes 4 tokens or 4000. Instead the KV lives in ONE preallocated arena of
+fixed-size pages per layer (``[num_pages, page_size, heads, head_dim]``) and
+each sequence owns an ordered *block table* of page indices; allocation is a
+free-list pop, eviction a push, and utilization follows actual lengths.
+
+This module is the ops half (pure array programs — the pool/allocator lives
+in ``engine.kv_cache``, the scheduler in ``engine.serve``):
+
+* :func:`paged_write` — scatter new K/V rows into the arena through a block
+  table at per-row positions (prefill writes a whole prompt, the decode tick
+  one token per sequence). Masked rows route to the arena's *trash page*
+  (index ``num_pages``, the reason arenas carry one extra page): the scatter
+  stays branch-free and fully static under jit.
+* :func:`gather_pages` — the read half: block table -> contiguous
+  ``(B, max_pages * page_size, ...)`` view of each sequence's cache.
+* :func:`paged_attend` — the attention entry ``models.transformer.
+  attend_maybe_cached`` delegates to: prefill attends within the prompt via
+  the model's own ``attn_fn`` (+ page writes); the decode tick writes one
+  row and attends over the gathered pages with PER-ROW positions — the
+  continuous-batching difference from the flax cache, whose scalar
+  ``cache_index`` forces every batch row to the same position.
+* int8 arenas: pages hold int8 values + one fp32 scale per (page-slot, head)
+  row — the ``ops.flash_attention.quantize_kv`` layout, quantized by
+  ``ops.quant.quantize_int8`` itself so the rounding convention can never
+  drift. The exact read path dequantizes the gathered tiles;
+  :func:`int8kv_paged_flash_attention_fn` is the Pallas variant that
+  consumes the gathered int8 layout directly (dequant per VMEM tile, K/V
+  never fp in HBM) with a per-row LENGTH mask instead of the training
+  kernels' causal offsets — the decode-tick geometry where every batch row
+  sits at a different position.
+
+Exactness contract: the exact read path mirrors ``full_attention``'s math
+op-for-op (fp32 scores/softmax, same einsum contractions), and masked slots
+contribute *exactly zero* weight — so greedy decode through pages is
+bit-identical to the contiguous-cache path (tests/test_serve.py pins it).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist.ops.flash_attention import _STAT_LANES, NEG_INF, _blocks, _fold
+
+
+def pages_for(length: int, page_size: int) -> int:
+    """Pages a sequence of ``length`` tokens occupies (host-side helper)."""
+    return -(-int(length) // int(page_size))
+
+
+@jax.tree_util.register_pytree_node_class
+class PagedLayer:
+    """One layer's KV page arenas as a jit-traversable pack.
+
+    ``k``/``v`` are ``(num_pages + 1, page_size, heads, head_dim)`` — the
+    +1 is the trash page masked writes land on. int8 arenas additionally
+    carry ``k_scale``/``v_scale`` ``(num_pages + 1, page_size, heads)``
+    fp32. ``quant`` ("none" | "int8") and ``read`` ("exact" | "flash")
+    ride in the pytree *aux data*: they are static, participate in jit
+    cache keys, and can never be confused for traced values.
+    """
+
+    def __init__(self, k, v, k_scale=None, v_scale=None, *,
+                 quant: str = "none", read: str = "exact"):
+        self.k, self.v = k, v
+        self.k_scale, self.v_scale = k_scale, v_scale
+        self.quant, self.read = quant, read
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[0] - 1               # minus the trash page
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[1]
+
+    def replace(self, **kw) -> "PagedLayer":
+        fields = dict(k=self.k, v=self.v, k_scale=self.k_scale,
+                      v_scale=self.v_scale, quant=self.quant,
+                      read=self.read)
+        fields.update(kw)
+        return PagedLayer(**fields)
+
+    def tree_flatten(self):
+        return ((self.k, self.v, self.k_scale, self.v_scale),
+                (self.quant, self.read))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        k, v, ks, vs = children
+        return cls(k, v, ks, vs, quant=aux[0], read=aux[1])
+
+
+# ---------------------------------------------------------------------------
+# arena scatter / gather
+# ---------------------------------------------------------------------------
+
+def flat_slot_index(block_table, positions, page_size: int):
+    """(B, L) global arena slot indices for per-row token positions.
+
+    ``page_size`` is static (an arena shape constant); positions beyond the
+    table's reach are the CALLER's bug — the scheduler sizes tables to
+    ``ceil(max_len / page_size)`` so every legal position has a page.
+    """
+    page = jnp.take_along_axis(block_table,
+                               positions // page_size, axis=1)
+    return page * page_size + positions % page_size
+
+
+def paged_write(arena, block_table, positions, values, valid,
+                trash_page: int):
+    """Scatter ``values`` (B, L, ...) into ``arena`` (N+1, page_size, ...)
+    at per-row ``positions`` (B, L); rows where ``valid`` (B, L) is False
+    land on the trash page (slot 0) instead — a branch-free masked write.
+
+    Distinct live sequences own disjoint pages (the allocator's contract),
+    so live scatter indices never collide; trash collisions are harmless by
+    definition.
+    """
+    n1, page_size = arena.shape[0], arena.shape[1]
+    flat = flat_slot_index(block_table, positions, page_size)
+    flat = jnp.where(valid, flat, trash_page * page_size)
+    flat_arena = arena.reshape((n1 * page_size,) + arena.shape[2:])
+    flat_arena = flat_arena.at[flat.reshape(-1)].set(
+        values.reshape((-1,) + values.shape[2:]).astype(arena.dtype))
+    return flat_arena.reshape(arena.shape)
+
+
+def gather_pages(arena, block_table):
+    """Block table (B, max_pages) -> (B, max_pages * page_size, ...) —
+    each sequence's cache as one contiguous view (gather, no copy under
+    XLA fusion when consumed immediately)."""
+    g = arena[block_table]                       # (B, P, page_size, ...)
+    b, p, s = g.shape[:3]
+    return g.reshape((b, p * s) + g.shape[3:])
+
+
+# ---------------------------------------------------------------------------
+# exact read path (per-row positions)
+# ---------------------------------------------------------------------------
+
+def masked_attention(q, k, v, q_positions):
+    """``full_attention`` with a PER-ROW causal horizon: row ``b`` of ``q``
+    (B, Lq, H, D) sits at global position ``q_positions[b]`` (+ the local
+    offset for Lq > 1) and may attend to keys ``kpos <= qpos``. Mirrors
+    ``models.transformer.full_attention`` op-for-op (fp32 scores/softmax,
+    identical contractions) so the scalar-offset case is bit-identical —
+    the serving tick's degenerate-to-generate contract rides on this."""
+    d = q.shape[-1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k,
+        preferred_element_type=jnp.float32) / jnp.sqrt(d).astype(jnp.float32)
+    qpos = q_positions[:, None] + jnp.arange(q.shape[1])[None, :]  # (B, Lq)
+    kpos = jnp.arange(k.shape[1])                                  # (Lk,)
+    mask = kpos[None, None, :] <= qpos[:, :, None]                 # (B,Lq,Lk)
+    scores = jnp.where(mask[:, None, :, :], scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# int8-KV paged flash kernel (per-row length mask)
+# ---------------------------------------------------------------------------
+#
+# The training-side kernels (ops.flash_attention) mask causally from static
+# q/kv offsets — every batch row shares one geometry. A continuous-batching
+# decode tick breaks that: each row is ONE query at its OWN position over
+# its OWN gathered pages. This variant replaces the causal bounds with a
+# per-row live-length input read from SMEM-adjacent stat lanes (same
+# (B*H, L, _STAT_LANES) layout as the int8 scales), masking kpos >= length.
+
+def _paged_int8kv_kernel(q_ref, k_ref, v_ref, ks_ref, vs_ref, len_ref,
+                         o_ref, acc_ref, m_ref, l_ref, *,
+                         bq, bk, nk, scale):
+    import jax.experimental.pallas as pl
+
+    ik = pl.program_id(1)
+    k_start = ik * bk
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # dequant on the VMEM tile — the only fp copy of this KV block
+    kf = k_ref[0].astype(jnp.float32) * ks_ref[0][:, :1]         # (bk, D)
+    vf = v_ref[0].astype(jnp.float32) * vs_ref[0][:, :1]
+    s = jax.lax.dot_general(
+        q_ref[0].astype(jnp.float32), kf, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale               # (bq, bk)
+    live = len_ref[0][:1, :1]                                     # (1, 1)
+    kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    s = jnp.where(kpos < live.astype(jnp.int32), s, NEG_INF)
+    m_prev = m_ref[...]
+    l_prev = l_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new[:, :1]))
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = (acc_ref[...] * alpha[:, :1]
+                    + jax.lax.dot_general(
+                        p, vf, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32))
+    m_ref[...] = m_new
+    l_ref[...] = l_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l_cur = jnp.maximum(l_ref[..., :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_cur).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def int8kv_paged_flash_attention_fn(block_k: int = 512,
+                                    interpret: bool | None = None):
+    """Returns ``attn(q, kq, ks, vq, vs, lengths)`` over GATHERED int8 KV
+    pages: ``q`` (B, 1, H, D) one query per row, ``kq``/``vq``
+    (B, L, H, D) int8 with per-(b, l, h) fp32 scales (the
+    ``quantize_kv``/arena layout), ``lengths`` (B,) live tokens per row —
+    keys at ``kpos >= length`` are masked, which IS the causal mask when
+    ``length = position + 1``. Dequant happens per VMEM tile inside the
+    kernel; the fp K/V never exist in HBM. Forward-only (decode).
+    ``interpret=None`` auto-selects interpreter mode off-TPU."""
+
+    def attn(q, kq, ks, vq, vs, lengths):
+        import jax.experimental.pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        use_interpret = (interpret if interpret is not None
+                         else jax.default_backend() != "tpu")
+        b, lq, h, d = q.shape
+        if lq != 1:
+            raise ValueError(f"paged decode kernel is one query per row "
+                             f"(got Lq={lq})")
+        lk = kq.shape[1]
+        _, bk = _blocks(lq, lk, lq, block_k)
+        qf = _fold(q)                                  # (B*H, 1, D)
+        kf, vf = _fold(kq), _fold(vq)                  # (B*H, L, D) int8
+        scale = 1.0 / math.sqrt(d)
+
+        def fold_scale(s):
+            s2 = jnp.swapaxes(s, 1, 2).reshape(b * h, lk)
+            return jnp.broadcast_to(s2[..., None], (b * h, lk, _STAT_LANES))
+        ksf, vsf = fold_scale(ks), fold_scale(vs)
+        # per-(b, h) live length in the stat-lane layout: (B*H, 1, LANES)
+        lens = jnp.broadcast_to(
+            jnp.repeat(lengths.astype(jnp.float32), h)[:, None, None],
+            (b * h, 1, _STAT_LANES))
+        grid = (b * h, lk // bk)
+
+        out = pl.pallas_call(
+            functools.partial(_paged_int8kv_kernel, bq=lq, bk=bk,
+                              nk=lk // bk, scale=scale),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, lq, d), lambda bh, ik: (bh, 0, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, ik: (bh, ik, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, ik: (bh, ik, 0)),
+                pl.BlockSpec((1, bk, _STAT_LANES),
+                             lambda bh, ik: (bh, ik, 0)),
+                pl.BlockSpec((1, bk, _STAT_LANES),
+                             lambda bh, ik: (bh, ik, 0)),
+                pl.BlockSpec((1, 1, _STAT_LANES),
+                             lambda bh, ik: (bh, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, lq, d), lambda bh, ik: (bh, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((lq, d), jnp.float32),           # acc
+                pltpu.VMEM((lq, _STAT_LANES), jnp.float32),  # running max
+                pltpu.VMEM((lq, _STAT_LANES), jnp.float32),  # running sum
+            ],
+            interpret=use_interpret,
+        )(qf, kf, vf, ksf, vsf, lens)
+        return jnp.swapaxes(out.reshape(b, h, lq, d), 1, 2)
+
+    return attn
+
+
+# ---------------------------------------------------------------------------
+# the attend_maybe_cached delegate
+# ---------------------------------------------------------------------------
+
+def _quantize_rows(x):
+    """(B, L, H, D) -> int8 values + per-(b, l, h) fp32 scales — the
+    ``quantize_kv`` arena convention, via ``ops.quant.quantize_int8``."""
+    from tpu_dist.ops.quant import quantize_int8
+
+    q, scale = quantize_int8(x, (-1,))
+    return q, scale[..., 0].astype(jnp.float32)
+
+
+def paged_attend(q, k, v, paged: dict, *, prefill: bool, attn_fn, dtype):
+    """One layer's paged-cache attention step; the delegate
+    ``models.transformer.attend_maybe_cached`` calls when a ``paged`` pack
+    is threaded through the model.
+
+    ``paged`` carries the layer's arenas plus the shared context:
+    ``{"layer": PagedLayer, "block_tables": (B, max_pages) i32,
+    "positions": (B,) i32, "lengths": (B,) i32}``. Prefill (``prefill=
+    True``): the queries attend within the prompt through the model's own
+    ``attn_fn`` (plain causal self-attention — nothing to read back), and
+    all ``lengths[b]`` leading K/V rows are written to the pages; the tick
+    (``prefill=False``, Lq == 1) writes one row at ``positions[b]`` and
+    attends over the gathered pages with per-row positions.
+
+    Returns ``(out, new_layer)`` — the functionally-updated arenas thread
+    back out through the model call.
+    """
+    layer = paged["layer"]
+    bt = paged["block_tables"]
+    positions = paged["positions"]
+    lengths = paged["lengths"]
+    trash = layer.num_pages                      # the extra page's index
+
+    b, lq = q.shape[0], q.shape[1]
+    if prefill:
+        write_pos = jnp.broadcast_to(jnp.arange(lq, dtype=jnp.int32)[None],
+                                     (b, lq))
+        valid = write_pos < lengths[:, None]
+    else:
+        write_pos = positions[:, None].astype(jnp.int32)        # (B, 1)
+        valid = jnp.ones((b, 1), dtype=bool)
+
+    if layer.quant == "int8":
+        kq, ks = _quantize_rows(k)
+        vq, vs = _quantize_rows(v)
+        new_layer = layer.replace(
+            k=paged_write(layer.k, bt, write_pos, kq, valid, trash),
+            v=paged_write(layer.v, bt, write_pos, vq, valid, trash),
+            k_scale=paged_write(layer.k_scale, bt, write_pos, ks, valid,
+                                trash),
+            v_scale=paged_write(layer.v_scale, bt, write_pos, vs, valid,
+                                trash))
+    else:
+        new_layer = layer.replace(
+            k=paged_write(layer.k, bt, write_pos, k, valid, trash),
+            v=paged_write(layer.v, bt, write_pos, v, valid, trash))
+
+    if prefill:
+        # causal self-attention over the prompt itself — exactly the
+        # training contraction, so flash/blockwise plug-ins keep working
+        return attn_fn(q, k, v), new_layer
+
+    if layer.quant == "int8" and layer.read == "flash":
+        out = int8kv_paged_flash_attention_fn()(
+            q, gather_pages(new_layer.k, bt),
+            gather_pages(new_layer.k_scale, bt),
+            gather_pages(new_layer.v, bt),
+            gather_pages(new_layer.v_scale, bt),
+            positions + 1)
+        return out.astype(q.dtype), new_layer
+
+    gk = gather_pages(new_layer.k, bt)
+    gv = gather_pages(new_layer.v, bt)
+    if layer.quant == "int8":
+        gk = (gk.astype(jnp.float32)
+              * gather_pages(new_layer.k_scale, bt)[..., None]).astype(dtype)
+        gv = (gv.astype(jnp.float32)
+              * gather_pages(new_layer.v_scale, bt)[..., None]).astype(dtype)
+    return masked_attention(q, gk, gv, positions), new_layer
