@@ -20,14 +20,16 @@ python -m tools.distlint --sarif-out distlint.sarif --with-debt "$@"
 # trailing best — the apex-data_prefetcher class of silent regression.
 python tools/bench_track.py --check
 
-# Supervisor-policy gate (round 10), jax-free BY CONSTRUCTION: the elastic
-# supervisor must keep working on a bare login/CI host (no jax installed),
+# Supervisor-policy gate (round 10) + consensus-policy gate (round 13),
+# jax-free BY CONSTRUCTION: the elastic supervisor AND its cross-host
+# consensus must keep working on a bare login/CI host (no jax installed),
 # so this pass hard-blocks jax imports and runs the restart classification,
-# backoff math, degraded-shrink and fault-spec grammar as units. A stray
-# `import jax` creeping into parallel.supervisor / obs.faults / the lazy
-# parallel __init__ fails HERE, before any pod notices.
+# backoff math, degraded-shrink, fault-spec grammar, dense renumbering and
+# shrink->re-expand membership cycle as units. A stray `import jax`
+# creeping into parallel.supervisor / parallel.consensus / obs.faults /
+# the lazy parallel __init__ fails HERE, before any pod notices.
 python - <<'EOF'
-import builtins, signal
+import builtins, signal, tempfile
 
 _real = builtins.__import__
 def _guard(name, *a, **k):
@@ -37,26 +39,62 @@ def _guard(name, *a, **k):
 builtins.__import__ = _guard
 
 from tpu_dist.obs.faults import FaultPlan
-from tpu_dist.parallel.supervisor import (RestartPolicy, classify_attempt,
-                                          compute_backoff, degraded_env)
+from tpu_dist.parallel.supervisor import (PREEMPT_SNAPSHOT_RC, RestartPolicy,
+                                          classify_attempt, compute_backoff,
+                                          degraded_env)
 from tpu_dist.supervise import build_parser
 
 pol = RestartPolicy(backoff_base_s=1.0, backoff_max_s=8.0)
 assert [compute_backoff(n, pol) for n in (0, 1, 2, 3, 9)] == \
     [0.0, 1.0, 2.0, 4.0, 8.0]
+# per-host jitter: deterministic, decorrelated, bounded
+waits = [compute_backoff(3, pol, host_id=h) for h in range(4)]
+assert len(set(waits)) == 4
+assert all(4.0 <= w <= 4.0 * (1 + pol.backoff_jitter) for w in waits)
+assert waits == [compute_backoff(3, pol, host_id=h) for h in range(4)]
 end = {"event": "run_end", "status": "crashed",
        "error": "HealthError: val_loss spike"}
 assert classify_attempt([end], 1) == "health_halt"
 assert classify_attempt([], -signal.SIGTERM) == "preemption"
+assert classify_attempt([], PREEMPT_SNAPSHOT_RC) == "preemption_snapshotted"
+assert classify_attempt(
+    [{"event": "run_end", "status": "preempted"}], None) == \
+    "preemption_snapshotted"
 assert classify_attempt([], 1, stderr_tail="rendezvous failed") == "rendezvous"
 assert classify_attempt([{"event": "stall"}], -9, True) == "stall"
 assert classify_attempt([], 13) == "crash"
 env, n = degraded_env({"TPU_DIST_NUM_PROCESSES": "4"})
 assert n == 3 and env["TPU_DIST_DEGRADED"] == "1"
-plan = FaultPlan.parse("hard_exit@step=10,attempt=0;rendezvous_fail@times=2")
-assert plan.sites() == {"hard_exit", "rendezvous_fail"}
+plan = FaultPlan.parse("hard_exit@step=10,attempt=0;rendezvous_fail@times=2;"
+                       "preempt_deadline@step=5;host_return@nth=2")
+assert plan.sites() == {"hard_exit", "rendezvous_fail", "preempt_deadline",
+                        "host_return"}
 build_parser().parse_args(["--ledger", "x.jsonl", "--", "true"])
-print("supervisor policy gate: OK (no jax)")
+
+# consensus-policy gate: one full shrink -> renumber -> re-expand cycle on
+# real files, no jax anywhere on the import path
+from tpu_dist.parallel.consensus import ConsensusDir, consensus_env
+
+with tempfile.TemporaryDirectory() as d:
+    now = [1000.0]
+    hosts = [ConsensusDir(d, h, planned=3, lease_s=5.0,
+                          now=lambda: now[0]) for h in range(3)]
+    for c in hosts:
+        c.register()
+    view = hosts[0].resolve()
+    assert view.epoch == 0 and view.hosts == (0, 1, 2)
+    hosts[1].leave()                       # mid-numbered host loss
+    view = hosts[2].resolve()
+    assert view.epoch == 1 and view.hosts == (0, 2) and view.degraded
+    assert view.process_id(2) == 1         # the id hole is CLOSED
+    cenv = consensus_env({}, view, 2)
+    assert cenv["TPU_DIST_PROCESS_ID"] == "1"
+    assert cenv["TPU_DIST_DEGRADED"] == "1"
+    hosts[1].register()                    # the lost host returns
+    view = hosts[0].resolve()
+    assert view.epoch == 2 and view.hosts == (0, 2, 1)  # survivors first
+    assert not view.degraded and view.process_id(1) == 2
+print("supervisor + consensus policy gates: OK (no jax)")
 EOF
 
 # Advisory tier-1 budget creep warning (never fails the gate): conftest

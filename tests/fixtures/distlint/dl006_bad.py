@@ -17,3 +17,8 @@ def emit_serving_badly(ledger):
     # round 11: the serving events (engine.serve) are schema-checked too
     ledger.emit("request", rid=7, tokens=12)   # missing the timeline fields
     ledger.emit("kv_cache", pages_free=3)      # missing used/active_seqs
+
+
+def emit_scale_badly(ledger):
+    # round 13: the elasticity event without its world size / epoch
+    ledger.emit("scale", action="expand")

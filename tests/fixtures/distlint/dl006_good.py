@@ -28,3 +28,12 @@ def emit_serving_well(ledger):
                 prompt_len=8, ttft_s=0.5)
     ledger.emit("kv_cache", pages_free=3, pages_used=13, active_seqs=4,
                 pages_total=16, high_water_used=16, slots=4, tick=40)
+
+
+def emit_scale_well(ledger):
+    # round 13: elastic-capacity transitions (supervisor consensus +
+    # engine preemption snapshot) — action/processes/epoch required
+    ledger.emit("scale", action="shrink", processes=2, epoch=1,
+                hosts=[0, 2], world_from=3)
+    ledger.emit("scale", action="preempt_snapshot", processes=1, epoch=0,
+                step=20)

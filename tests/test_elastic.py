@@ -1,0 +1,561 @@
+"""Elastic self-scaling (round 13): consensus renumbering, two-way
+shrink/grow, coordinated preemption snapshots, peer state restore.
+
+Every elastic path is PRODUCED on demand on one CPU box: the consensus
+protocol as pure file-backed units, dense renumbering on a mid-numbered
+host loss (closing the PR-10 ``degraded_env`` KNOWN LIMIT), the
+rendezvous-epoch coordinator offset, per-host backoff jitter, the
+``preemption_snapshotted`` class, a fake-child chaos run through the full
+shrink -> degraded -> re-expansion cycle with ``scale``-event evidence,
+and the ISSUE 13 acceptance smoke: an injected ``preempt_deadline``
+produces a coordinated snapshot whose resume step equals the
+pre-preemption step, visible in the ledger_report elasticity timeline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_dist.obs import faults
+from tpu_dist.obs.goodput import discover_attempt_paths
+from tpu_dist.obs.ledger import read_ledger
+from tpu_dist.parallel.consensus import (ConsensusDir, MeshView,
+                                         consensus_env, successor_hosts)
+from tpu_dist.parallel.launch import detect_launch, epoch_coordinator
+from tpu_dist.parallel.supervisor import (PREEMPT_SNAPSHOT_RC, RestartPolicy,
+                                          Supervisor, classify_attempt,
+                                          compute_backoff)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(monkeypatch):
+    """Fault plans are process-global; tests must not leak them."""
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults._reset_for_tests()
+    yield
+    faults._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# the consensus protocol as pure file-backed units
+
+def _three_hosts(tmp_path, now, lease_s=5.0):
+    return [ConsensusDir(str(tmp_path), h, planned=3, lease_s=lease_s,
+                         now=lambda: now[0]) for h in range(3)]
+
+
+def test_consensus_initial_epoch_is_full_sorted_mesh(tmp_path):
+    now = [1000.0]
+    hosts = _three_hosts(tmp_path, now)
+    for c in hosts:
+        c.register()
+    views = [c.resolve() for c in hosts]
+    assert all(v == MeshView(0, (0, 1, 2), 3) for v in views)
+    assert not views[0].degraded
+
+
+def test_mid_numbered_loss_renumbers_densely(tmp_path):
+    # THE closed KNOWN LIMIT: host 1 (mid-numbered) dies; the survivors
+    # agree on dense ids 0/1 instead of leaving the 0/2 hole that made
+    # the shrunken rendezvous impossible
+    now = [1000.0]
+    hosts = _three_hosts(tmp_path, now)
+    for c in hosts:
+        c.register()
+    hosts[0].resolve()
+    hosts[1].leave()
+    v0, v2 = hosts[0].resolve(), hosts[2].resolve()
+    assert v0 == v2 == MeshView(1, (0, 2), 3)
+    assert v0.degraded and v0.world_size == 2
+    assert v0.process_id(0) == 0 and v0.process_id(2) == 1  # dense
+    with pytest.raises(KeyError):
+        v0.process_id(1)
+    env = consensus_env({"TPU_DIST_NUM_PROCESSES": "3",
+                         "TPU_DIST_PROCESS_ID": "2"}, v0, 2)
+    assert env["TPU_DIST_NUM_PROCESSES"] == "2"
+    assert env["TPU_DIST_PROCESS_ID"] == "1"
+    assert env["TPU_DIST_DEGRADED"] == "1"
+    assert env["TPU_DIST_MESH_EPOCH"] == "1"
+
+
+def test_return_re_expands_survivors_first(tmp_path):
+    # two-way shrink: the returning host appends AFTER the survivors, so
+    # survivor ids never shift up and process 0 always holds live state
+    now = [1000.0]
+    hosts = _three_hosts(tmp_path, now)
+    for c in hosts:
+        c.register()
+    hosts[0].resolve()
+    hosts[1].leave()
+    hosts[2].resolve()
+    hosts[1].register()
+    v = hosts[0].resolve()
+    assert v == MeshView(2, (0, 2, 1), 3)
+    assert not v.degraded
+    assert v.process_id(2) == 1 and v.process_id(1) == 2
+    env = consensus_env({"TPU_DIST_DEGRADED": "1"}, v, 1)
+    assert "TPU_DIST_DEGRADED" not in env  # full strength: marker cleared
+
+
+def test_lease_expiry_is_host_loss(tmp_path):
+    now = [1000.0]
+    hosts = _three_hosts(tmp_path, now, lease_s=5.0)
+    for c in hosts:
+        c.register()
+    hosts[0].resolve()
+    now[0] += 10.0           # everyone's heartbeat ages out...
+    hosts[0].register()
+    hosts[2].register()      # ...but 0 and 2 come back; 1 stays silent
+    v = hosts[0].resolve()
+    assert v.hosts == (0, 2) and v.epoch == 1 and v.degraded
+
+
+def test_successor_hosts_is_pure_and_stable():
+    assert successor_hosts([0, 1, 2], [0, 2]) == [0, 2]
+    assert successor_hosts([0, 2], [0, 1, 2]) == [0, 2, 1]
+    assert successor_hosts([], [2, 0]) == [0, 2]
+    # racing writers with the same inputs compute identical views
+    assert successor_hosts([3, 1], [1, 3, 0]) == \
+        successor_hosts([3, 1], [0, 1, 3])
+
+
+def test_host_return_fault_resurrects_lost_hosts(tmp_path):
+    # the CPU-provable re-expansion trigger: no real second host needed
+    now = [1000.0]
+    hosts = _three_hosts(tmp_path, now)
+    for c in hosts:
+        c.register()
+    hosts[0].resolve()
+    hosts[1].leave()
+    assert hosts[0].resolve().hosts == (0, 2)
+    faults.install("host_return@nth=1")
+    v = hosts[0].resolve()
+    assert v.hosts == (0, 2, 1) and not v.degraded
+
+
+# ---------------------------------------------------------------------------
+# rendezvous-epoch coordinator offset (parallel.launch)
+
+def test_epoch_coordinator_offsets_port():
+    assert epoch_coordinator("10.0.0.1:8476", 0) == "10.0.0.1:8476"
+    assert epoch_coordinator("10.0.0.1:8476", 3) == "10.0.0.1:8479"
+    assert epoch_coordinator("[::1]:8476", 2) == "[::1]:8478"
+    assert epoch_coordinator("not-a-port", 2) == "not-a-port"
+    assert epoch_coordinator("", 2) == ""
+
+
+def test_detect_launch_applies_mesh_epoch(monkeypatch):
+    monkeypatch.setenv("TPU_DIST_COORDINATOR", "127.0.0.1:9000")
+    monkeypatch.setenv("TPU_DIST_NUM_PROCESSES", "2")
+    monkeypatch.setenv("TPU_DIST_PROCESS_ID", "1")
+    monkeypatch.setenv("TPU_DIST_MESH_EPOCH", "2")
+    info = detect_launch()
+    assert info.coordinator == "127.0.0.1:9002"
+    assert info.num_processes == 2 and info.process_id == 1
+    monkeypatch.delenv("TPU_DIST_MESH_EPOCH")
+    assert detect_launch().coordinator == "127.0.0.1:9000"
+
+
+# ---------------------------------------------------------------------------
+# per-host backoff jitter (the restart-stampede fix)
+
+def test_backoff_jitter_is_deterministic_decorrelated_and_bounded():
+    pol = RestartPolicy(backoff_base_s=1.0, backoff_max_s=8.0,
+                        backoff_jitter=0.5)
+    base = compute_backoff(3, pol)          # no host: bare exponential
+    assert base == 4.0
+    waits = [compute_backoff(3, pol, host_id=h) for h in range(8)]
+    # every host gets its own offset (the stampede is broken)...
+    assert len(set(waits)) == 8
+    # ...within [base, base * (1 + jitter)]...
+    assert all(base <= w <= base * 1.5 for w in waits)
+    # ...and the same host always picks the same wait (reproducible runs)
+    assert waits == [compute_backoff(3, pol, host_id=h) for h in range(8)]
+    # the restart ordinal decorrelates REPEAT collisions too
+    assert compute_backoff(4, pol, host_id=3) / 8.0 != \
+        compute_backoff(3, pol, host_id=3) / 4.0
+    # jitter off -> bare schedule even with a host id
+    off = RestartPolicy(backoff_base_s=1.0, backoff_max_s=8.0,
+                        backoff_jitter=0.0)
+    assert compute_backoff(3, off, host_id=5) == 4.0
+
+
+# ---------------------------------------------------------------------------
+# the preemption_snapshotted class
+
+@pytest.mark.parametrize("records,rc,want", [
+    ([], PREEMPT_SNAPSHOT_RC, "preemption_snapshotted"),
+    ([{"event": "run_end", "steps": 5, "seconds": 1.0,
+       "status": "preempted", "snapshot_step": 5}], PREEMPT_SNAPSHOT_RC,
+     "preemption_snapshotted"),
+    # report-side view: records alone, no returncode
+    ([{"event": "run_end", "steps": 5, "seconds": 1.0,
+       "status": "preempted"}], None, "preemption_snapshotted"),
+    # an unhonored SIGTERM still classifies as plain preemption
+    ([], -15, "preemption"),
+])
+def test_classify_preemption_snapshotted(records, rc, want):
+    assert classify_attempt(records, rc) == want
+
+
+# ---------------------------------------------------------------------------
+# peer state restore (checkpoint-less dp-pure recovery)
+
+def test_peer_restore_state_unit():
+    from tpu_dist.engine import checkpoint as ckpt
+
+    state = {"w": np.ones((3,), np.float32), "step": np.int32(7)}
+    # single process: identity no-op, no collective entered
+    out, did = ckpt.peer_restore_state(state)
+    assert out is state and not did
+    # injected broadcast (the multi-host path's seam): every leaf is
+    # host-gathered and replaced by the broadcast result
+    calls = []
+
+    def fake_broadcast(tree):
+        calls.append(tree)
+        return {"w": np.full((3,), 7.0, np.float32), "step": np.int32(42)}
+
+    out, did = ckpt.peer_restore_state(state, broadcast=fake_broadcast)
+    assert did and len(calls) == 1
+    assert np.all(out["w"] == 7.0) and int(out["step"]) == 42
+    assert isinstance(calls[0]["w"], np.ndarray)  # host-side tree
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: the full shrink -> degraded -> re-expansion cycle with a
+# stdlib-only fake child (3 fake hosts, kill host 1, host 1 returns)
+
+_ELASTIC_CHILD = r"""
+import json, os, signal, sys, time
+
+argv = sys.argv[1:]
+base = argv[argv.index("--ledger-base") + 1]
+attempt = int(os.environ.get("TPU_DIST_ATTEMPT", "0"))
+root, ext = os.path.splitext(base)
+path = base if attempt == 0 else f"{root}.a{attempt}{ext}"
+f = open(path, "a")
+
+def emit(event, **kw):
+    f.write(json.dumps({"event": event, "ts": time.time(), **kw}) + "\n")
+    f.flush()
+
+world = os.environ.get("TPU_DIST_NUM_PROCESSES")
+degraded = os.environ.get("TPU_DIST_DEGRADED") == "1"
+emit("run_start", attempt=attempt, kind="fake", config={},
+     mesh=None, devices=[], process_count=int(world or 1),
+     degraded=degraded,
+     mesh_epoch=int(os.environ.get("TPU_DIST_MESH_EPOCH", "0") or 0))
+emit("step", step=0, loss=None, throughput=None, unit="tok/s",
+     data_s=None, dispatch_s=None, device_s=None, comm_s=None, mfu=None)
+
+def on_term(signum, frame):
+    # the engines' coordinated-snapshot contract, faked: run_end with
+    # status=preempted, exit 75 (PREEMPT_SNAPSHOT_RC)
+    emit("run_end", steps=1, seconds=0.1, status="preempted",
+         snapshot_step=1)
+    os._exit(75)
+
+signal.signal(signal.SIGTERM, on_term)
+
+if degraded:
+    # the dense-id check: a 3-host mesh minus mid-numbered host 1 must
+    # relaunch as a 2-process world with ids renumbered 0/1
+    if world != "2" or os.environ.get("TPU_DIST_PROCESS_ID") != "0":
+        sys.exit(9)
+    time.sleep(30)  # run "forever"; the re-expansion SIGTERM ends us
+    sys.exit(8)
+# full-strength attempt after re-expansion: restored world + peer resume
+if attempt > 0:
+    ok = (world == "3" and os.environ.get("TPU_DIST_PEER_RESUME") == "1"
+          and os.environ.get("TPU_DIST_DEGRADED") is None)
+    if not ok:
+        sys.exit(9)
+emit("run_end", steps=1, seconds=0.1, status="ok")
+"""
+
+
+def test_chaos_shrink_then_reexpand_with_consensus(tmp_path):
+    """ISSUE 13 acceptance (shrink/grow half): kill mid-numbered host 1 of
+    a 3-host mesh -> the supervisor's first attempt runs dp-only on the
+    dense-id survivors (NO restarts_exhausted); host 1 re-registers
+    mid-attempt -> the supervisor SIGTERMs the child (which snapshots),
+    relaunches at the restored world size with peer resume, and the whole
+    cycle is on the record: scale events, attempt classes, and the
+    ledger_report elasticity timeline."""
+    script = tmp_path / "child.py"
+    script.write_text(_ELASTIC_CHILD)
+    ledger = str(tmp_path / "run.jsonl")
+    cdir = str(tmp_path / "consensus")
+    # 3 registered hosts, epoch 0 agreed; then mid-numbered host 1 dies
+    peers = [ConsensusDir(cdir, h, planned=3, lease_s=60.0)
+             for h in range(3)]
+    for c in peers:
+        c.register()
+    assert peers[0].resolve().hosts == (0, 1, 2)
+    peers[1].leave()
+
+    env = dict(os.environ)
+    env.update({"TPU_DIST_NUM_PROCESSES": "3", "TPU_DIST_PROCESS_ID": "0"})
+    sup = Supervisor(
+        [sys.executable, str(script), "--ledger-base", ledger],
+        ledger=ledger,
+        policy=RestartPolicy(max_restarts=2, backoff_base_s=0.01,
+                             stall_timeout_s=60.0),
+        env=env, forward_flags=False, poll_s=0.05,
+        consensus=ConsensusDir(cdir, 0, planned=3, lease_s=60.0),
+        consensus_poll_s=0.15)
+
+    # host 1 returns while the degraded attempt is running
+    returner = threading.Timer(1.0, peers[1].register)
+    returner.start()
+    try:
+        res = sup.run()
+    finally:
+        returner.cancel()
+    assert res.ok, [(a.failure_class, a.returncode) for a in res.attempts]
+    # attempt 0: degraded run, ended by OUR rescale SIGTERM with a
+    # snapshot; attempt 1: clean at the restored world size. The rescale
+    # relaunch consumed NO restart budget.
+    assert [a.failure_class for a in res.attempts] == \
+        ["preemption_snapshotted", "clean"]
+    assert sup.env["TPU_DIST_NUM_PROCESSES"] == "3"
+    assert "TPU_DIST_DEGRADED" not in sup.env
+    assert not sup.degraded
+
+    # the supervisor's scale ledger: shrink (epoch 1) then expand (epoch 2)
+    sup_ledger = str(tmp_path / "run.sup.jsonl")
+    scales = [r for r in read_ledger(sup_ledger, validate=False,
+                                     strict=False)
+              if r.get("event") == "scale"]
+    assert [s["action"] for s in scales] == ["shrink", "expand"]
+    assert scales[0]["processes"] == 2 and scales[0]["hosts"] == [0, 2]
+    assert scales[1]["processes"] == 3 and scales[1]["hosts"] == [0, 2, 1]
+
+    # the stitched report: restarts + elasticity sections tell the story
+    sys.path.insert(0, ROOT)
+    from tools.ledger_report import elasticity_section, restarts_section
+    records = []
+    # the ledger_report merge shape: attempt files in order, the
+    # supervisor sibling APPENDED (never ts-interleaved)
+    for p in discover_attempt_paths(ledger) + [sup_ledger]:
+        records += read_ledger(p, validate=False, strict=False)
+    lines = []
+    rep = restarts_section(records, out=lines.append)
+    assert [a["class"] for a in rep["attempts"]] == \
+        ["preemption_snapshotted", "clean"]
+    assert rep["attempts"][0]["degraded"] is True
+    assert rep["attempts"][1]["degraded"] is False
+    rows = elasticity_section(records, out=lines.append)
+    assert [r["action"] for r in rows] == ["shrink", "expand"]
+    text = "\n".join(lines)
+    assert "mesh shrink" in text and "mesh re-expansion" in text
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: injected preempt_deadline -> coordinated snapshot whose
+# resume step equals the pre-preemption step (real LM script on CPU)
+
+def _script_env(**extra):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("TPU_DIST") and k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env
+
+
+_LM_TINY = ["--epochs", "2", "--batch-size", "4", "--seq-len", "32",
+            "--d-model", "32", "--num-layers", "1", "--num-heads", "2",
+            "--vocab-size", "64", "--synth-tokens", "2000",
+            "--print-freq", "1"]
+
+
+def test_preempt_deadline_snapshot_resumes_exact_step(tmp_path):
+    """ISSUE 13 acceptance (snapshot half): an injected preempt_deadline
+    at step 20 of attempt 0 makes the engine finish its in-flight work,
+    write the coordinated snapshot and exit PREEMPT_SNAPSHOT_RC; the
+    supervised restart resumes from EXACTLY the pre-preemption step (not
+    the last periodic checkpoint), and the preemption is visible in the
+    ledger_report elasticity timeline."""
+    ledger = str(tmp_path / "run.jsonl")
+    sup = Supervisor(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "8.lm_longcontext.py"), *_LM_TINY],
+        ledger=ledger, ckpt_dir=str(tmp_path / "ck"),
+        policy=RestartPolicy(max_restarts=2, backoff_base_s=0.05,
+                             stall_timeout_s=300.0),
+        env=_script_env(
+            TPU_DIST_FAULTS="preempt_deadline@step=20,attempt=0"),
+        poll_s=0.1)
+    res = sup.run()
+    assert res.ok, [(a.failure_class, a.returncode) for a in res.attempts]
+    assert [a.failure_class for a in res.attempts] == \
+        ["preemption_snapshotted", "clean"]
+    assert res.attempts[0].returncode == PREEMPT_SNAPSHOT_RC
+
+    paths = discover_attempt_paths(ledger)
+    att0 = read_ledger(paths[0], validate=False, strict=False)
+    att1 = read_ledger(paths[1], validate=False, strict=False)
+    end0 = [r for r in att0 if r.get("event") == "run_end"][-1]
+    assert end0["status"] == "preempted"
+    snap_step = end0["snapshot_step"]
+    # the snapshot step IS the pre-preemption step: every step the first
+    # attempt applied is in it (fault fires before dispatching step 20,
+    # with steps 0..19 already applied -> state.step == 20)
+    steps0 = [r["step"] for r in att0 if r.get("event") == "step"]
+    assert snap_step == len(steps0) == max(steps0) + 1 == 20
+    # the committed snapshot container names exactly that step (read the
+    # retained keep-K sibling: the bare pointer has since advanced past
+    # it — the clean second attempt wrote its own epoch checkpoints)
+    from tpu_dist.engine.checkpoint import read_checkpoint_meta
+    snap = os.path.join(str(tmp_path / "ck"),
+                        f"lm-checkpoint.r{snap_step}.msgpack")
+    assert os.path.exists(snap)
+    meta = read_checkpoint_meta(snap)
+    assert meta["step"] == snap_step and meta.get("preempt") is True
+    # and the restart resumed there: its first step record continues the
+    # trajectory with no retrained (or skipped) steps
+    starts1 = [r for r in att1 if r.get("event") == "run_start"]
+    assert starts1[0]["config"]["resume"].endswith("lm-checkpoint.msgpack")
+    steps1 = [r["step"] for r in att1 if r.get("event") == "step"]
+    assert min(steps1) == snap_step
+    # the engine's scale event + the elasticity timeline render it
+    scales = [r for r in att0 if r.get("event") == "scale"]
+    assert [s["action"] for s in scales] == ["preempt_snapshot"]
+    assert scales[0]["step"] == snap_step
+    sys.path.insert(0, ROOT)
+    from tools.ledger_report import elasticity_section
+    lines = []
+    rows = elasticity_section(att0 + att1, out=lines.append)
+    assert [r["action"] for r in rows] == ["preempt_snapshot"]
+    assert "preemption snapshot" in "\n".join(lines)
+
+
+def test_sigterm_during_run_is_honored_with_snapshot(tmp_path):
+    """The real signal path, no supervisor: SIGTERM to a training child
+    mid-epoch produces the coordinated snapshot + rc 75 (the crash guard's
+    old immediate-death path only remains for loops that never enabled
+    snapshots)."""
+    ledger = str(tmp_path / "run.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "8.lm_longcontext.py"), *_LM_TINY,
+         "--ledger-path", ledger,
+         "--checkpoint-dir", str(tmp_path / "ck")],
+        env=_script_env(), stderr=subprocess.PIPE, text=True)
+    # wait for the first step record (the run is mid-epoch), then preempt
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if os.path.exists(ledger) and any(
+                r.get("event") == "step"
+                for r in read_ledger(ledger, validate=False, strict=False)):
+            break
+        if proc.poll() is not None:
+            break
+        time.sleep(0.2)
+    assert proc.poll() is None, proc.stderr.read()
+    proc.send_signal(15)
+    rc = proc.wait(timeout=120)
+    proc.stderr.read()
+    assert rc == PREEMPT_SNAPSHOT_RC
+    records = read_ledger(ledger, validate=False, strict=False)
+    end = [r for r in records if r.get("event") == "run_end"][-1]
+    assert end["status"] == "preempted"
+    assert any(r.get("event") == "scale"
+               and r.get("action") == "preempt_snapshot" for r in records)
+    # the snapshot container exists and its pointer step matches
+    with open(os.path.join(str(tmp_path / "ck"),
+                           "lm-checkpoint.index.json")) as f:
+        assert json.load(f)["step"] == end["snapshot_step"]
+
+
+# ---------------------------------------------------------------------------
+# review-fix regressions
+
+def test_resolve_view_keys_on_world_size_not_degraded_edges(tmp_path):
+    """A second loss while ALREADY degraded (4->3->2) is still a shrink,
+    and a partial return (2->3, still short of plan) is still an
+    expansion that arms peer resume — transitions key on world-size
+    changes, not on degraded-flag edges."""
+    cdir = str(tmp_path / "c")
+    peers = [ConsensusDir(cdir, h, planned=4, lease_s=60.0)
+             for h in range(4)]
+    for c in peers:
+        c.register()
+    sup = Supervisor(["true"], ledger=str(tmp_path / "run.jsonl"),
+                     consensus=ConsensusDir(cdir, 0, planned=4,
+                                            lease_s=60.0))
+    assert sup._resolve_view().world_size == 4 and not sup.degraded
+    peers[2].leave()
+    sup._resolve_view()                     # 4 -> 3: shrink
+    peers[3].leave()
+    sup._resolve_view()                     # 3 -> 2: STILL a shrink
+    peers[3].register()
+    v = sup._resolve_view()                 # 2 -> 3: partial expansion
+    assert v.degraded and sup._peer_resume_next
+    sup._peer_resume_next = False
+    peers[2].register()
+    v = sup._resolve_view()                 # 3 -> 4: full strength
+    assert not v.degraded and sup._peer_resume_next
+    scales = [r for r in read_ledger(str(tmp_path / "run.sup.jsonl"),
+                                     validate=False, strict=False)
+              if r.get("event") == "scale"]
+    assert [s["action"] for s in scales] == \
+        ["shrink", "shrink", "expand", "expand"]
+    assert [(s["world_from"], s["processes"]) for s in scales] == \
+        [(4, 3), (3, 2), (2, 3), (3, 4)]
+
+
+def test_detect_launch_slurm_honors_consensus_overrides(monkeypatch):
+    """A supervisor relaunch after host loss exports shrunken TPU_DIST_*
+    values while SLURM_* still describes the original allocation — the
+    consensus renumbering and the epoch port offset must win."""
+    for k in ("TPU_DIST_COORDINATOR", "TPU_DIST_NUM_PROCESSES",
+              "TPU_DIST_PROCESS_ID", "TPU_DIST_MESH_EPOCH"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    monkeypatch.setenv("SLURM_NPROCS", "4")
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "node[1-4]")
+    info = detect_launch()
+    assert info.method == "slurm"
+    assert (info.num_processes, info.process_id) == (4, 3)
+    assert info.coordinator.endswith(":8476")
+    monkeypatch.setenv("TPU_DIST_NUM_PROCESSES", "3")
+    monkeypatch.setenv("TPU_DIST_PROCESS_ID", "2")
+    monkeypatch.setenv("TPU_DIST_MESH_EPOCH", "1")
+    info = detect_launch()
+    assert (info.num_processes, info.process_id) == (3, 2)
+    assert info.coordinator.endswith(":8477")  # fresh epoch, fresh port
+
+
+def test_preempt_deadline_fault_carries_secs():
+    # the effects mapping delivers the injected deadline to the engine
+    faults.install("preempt_deadline@step=5,secs=3")
+    effects = faults.fire_step(5)
+    assert set(effects) == {"preempt_deadline"}
+    assert effects["preempt_deadline"].args["secs"] == 3.0
+
+
+def test_host_return_injection_lands_a_fault_event(tmp_path):
+    # injected re-expansions must stay distinguishable from organic ones
+    from tpu_dist.obs.ledger import Ledger
+
+    records = []
+    c = ConsensusDir(str(tmp_path), 0, planned=2, lease_s=60.0)
+    c.fault_ledger = Ledger(None, sinks=(records.append,))
+    c.register()
+    c.resolve()
+    faults.install("host_return@nth=1")
+    v = c.resolve()
+    assert v.hosts == (0, 1)  # host 1 resurrected
+    fault_events = [r for r in records if r["event"] == "fault"]
+    assert len(fault_events) == 1
+    assert fault_events[0]["site"] == "host_return"

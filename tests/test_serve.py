@@ -314,3 +314,75 @@ def test_quantize_for_decode_lru_survives_alternating_trees():
         quant_mod.wo_quantize_params = orig
     assert len(calls) == 2, f"expected one quantization per tree, " \
                             f"got {len(calls)}"
+
+
+# ------------------------------------------------- graceful drain (round 13)
+def test_drain_finishes_inflight_sheds_queue_and_frees_pages():
+    """Graceful preemption drain: in-flight sequences run to completion
+    (their pages were paid for), queued requests are rejected with a
+    `shed` admission record, the pool ends fully free, and a `run_end`
+    lands — a drained server, not a mid-tick corpse."""
+    lm, params = _lm_and_params(seed=13)
+    led_records = []
+    ledger = Ledger(None, sinks=(led_records.append,))
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=2, page_size=4, num_pages=32), ledger=ledger)
+    reqs = [DecodeRequest(i, np.array([1, 2, 3], np.int32), 6)
+            for i in range(6)]
+    for r in reqs:
+        assert eng.submit(r)
+    eng.step()  # two slots prefilled + one decode tick; four still queued
+    inflight = {s.req.rid for s in eng.slots if s is not None}
+    assert len(inflight) == 2 and len(eng.queue) == 4
+    comps = eng.drain(reason="sigterm")
+    # the two in-flight sequences finished their full generation
+    assert {c.rid for c in comps} == inflight
+    assert all(c.n_generated == 6 for c in comps)
+    # the queue was shed with per-request admission records
+    shed = [r for r in led_records if r["event"] == "admit"
+            and r.get("reason") == "shed"]
+    assert len(shed) == 4
+    assert eng.pool.pages_free == eng.pool.num_pages  # everything reclaimed
+    ends = [r for r in led_records if r["event"] == "run_end"]
+    assert len(ends) == 1 and ends[0]["status"] == "preempted"
+    assert ends[0]["shed"] == 4 and ends[0]["completed"] == 2
+    scales = [r for r in led_records if r["event"] == "scale"]
+    assert [s["action"] for s in scales] == ["drain"]
+    # draining is sticky: new submits shed, a second drain is a no-op
+    assert not eng.submit(DecodeRequest(99, np.array([1], np.int32), 2))
+    assert eng.drain() == []
+    assert sum(1 for r in led_records if r["event"] == "run_end") == 1
+
+
+def test_sigterm_routes_run_into_drain():
+    """The preemption signal itself: install_sigterm_drain() turns
+    SIGTERM into a flag, run() finishes the tick and drains instead of
+    dying mid-tick (engine/serve.py round-11 behavior)."""
+    import os
+    import signal as _signal
+
+    lm, params = _lm_and_params(seed=14)
+    led_records = []
+    ledger = Ledger(None, sinks=(led_records.append,))
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=1, page_size=4, num_pages=16), ledger=ledger)
+    uninstall = eng.install_sigterm_drain()
+    try:
+        for i in range(4):
+            assert eng.submit(DecodeRequest(i, np.array([1, 2], np.int32),
+                                            4))
+        eng.step()  # slot 0 in flight
+        os.kill(os.getpid(), _signal.SIGTERM)  # the scheduler's notice
+        comps = eng.run()  # would have processed all 4 without the signal
+    finally:
+        uninstall()
+    # only the in-flight request finished; the rest were shed
+    assert {c.rid for c in comps} == {0}
+    shed = [r for r in led_records if r["event"] == "admit"
+            and r.get("reason") == "shed"]
+    assert len(shed) == 3
+    assert eng.pool.pages_free == eng.pool.num_pages
+    assert [r["status"] for r in led_records
+            if r["event"] == "run_end"] == ["preempted"]
+    # the handler was restored by uninstall
+    assert _signal.getsignal(_signal.SIGTERM) not in (None,)

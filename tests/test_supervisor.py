@@ -133,7 +133,7 @@ def test_fault_env_var_installs_lazily(monkeypatch):
     monkeypatch.setenv(faults.ENV_VAR, "nan_batch@step=0")
     monkeypatch.setenv("TPU_DIST_ATTEMPT", "2")  # supervisor's child export
     faults._reset_for_tests()
-    assert faults.fire_step(0) == {"nan_batch"}
+    assert set(faults.fire_step(0)) == {"nan_batch"}
     assert faults._context["attempt"] == 2
 
 
@@ -715,6 +715,12 @@ _LM_TINY = ["--epochs", "2", "--batch-size", "4", "--seq-len", "32",
             "--print-freq", "1"]
 
 
+@pytest.mark.slow  # tier-1 budget offset (round 13): same supervised-LM
+# restart shape as the IN-budget round-13 acceptance
+# (tests/test_elastic.py::test_preempt_deadline_snapshot_resumes_exact_step
+# — two real attempts, checkpoint resume, stitched report), and the
+# hard-kill class itself keeps its cheap in-budget twin
+# (test_supervisor_restarts_after_fault_injected_exit)
 def test_chaos_smoke_supervised_lm_survives_hard_kill(tmp_path):
     ledger = str(tmp_path / "run.jsonl")
     # 15 steps/epoch; the epoch-1 checkpoint exists when step 20 dies

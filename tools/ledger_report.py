@@ -236,6 +236,8 @@ def restarts_section(records, out=print, crash_loop_k=3):
             "attempt": ordinal,
             "class": classify_attempt(recs),
             "steps": sum(1 for r in recs if r.get("event") == "step"),
+            "degraded": bool(starts and starts[0].get("degraded")),
+            "processes": starts[0].get("process_count") if starts else None,
             "injected": [str(r.get("site") or "?") for r in recs
                          if r.get("event") == "fault"]})
     organic = sum(1 for r in rows
@@ -245,6 +247,8 @@ def restarts_section(records, out=print, crash_loop_k=3):
     for r in rows:
         out(f"  attempt {r['attempt']}: {r['class']}, "
             f"{r['steps']} step record(s)"
+            + (f" [degraded mesh, {r['processes']} proc]" if r["degraded"]
+               else "")
             + (f"; injected fault(s): {', '.join(r['injected'])}"
                if r["injected"] else ""))
     trailing_dead = 0
@@ -261,6 +265,54 @@ def restarts_section(records, out=print, crash_loop_k=3):
         f"{organic} organic failure(s)")
     return {"attempts": rows, "injected_faults": len(fault_events),
             "organic_failures": organic, "crash_loop": crash_loop}
+
+
+_SCALE_LABELS = {"shrink": "mesh shrink", "expand": "mesh re-expansion",
+                 "preempt_snapshot": "preemption snapshot",
+                 "peer_restore": "peer state restore",
+                 "drain": "serve drain"}
+
+
+def elasticity_section(records, out=print):
+    """The elastic-capacity timeline (round 13): ``scale`` events — the
+    supervisor consensus' shrink/re-expansion decisions (stitched in from
+    the ``<stem>.sup.jsonl`` sibling), the engines' coordinated preemption
+    snapshots and peer state restores, and serving drains — rendered in
+    wall order so a shrink -> degraded attempts -> re-expansion cycle
+    reads as one story beside the goodput/restarts sections."""
+    scales = sorted((r for r in records if r["event"] == "scale"),
+                    key=lambda r: r.get("ts") or 0.0)
+    if not scales:
+        return None
+    # wall anchor: the earliest timestamp anywhere (the supervisor's
+    # sibling records are APPENDED to the stream, not ts-interleaved —
+    # interleaving would split pseudo-attempts into the goodput math)
+    t0 = min((r.get("ts") for r in records if r.get("ts") is not None),
+             default=0)
+    out(f"\nelasticity ({len(scales)} scale event(s)):")
+    rows = []
+    for r in scales:
+        dt = (r.get("ts") or t0) - t0
+        action = str(r.get("action") or "?")
+        extras = []
+        if r.get("world_from") is not None:
+            extras.append(f"{r['world_from']} -> {r.get('processes')} "
+                          "process(es)")
+        elif r.get("processes") is not None:
+            extras.append(f"{r['processes']} process(es)")
+        if r.get("hosts") is not None:
+            extras.append(f"hosts {r['hosts']}")
+        if r.get("step") is not None:
+            extras.append(f"step {r['step']}")
+        if r.get("shed") is not None:
+            extras.append(f"{r['shed']} request(s) shed")
+        out(f"  +{dt:8.1f}s  {_SCALE_LABELS.get(action, action):<22}"
+            + (f" epoch {r['epoch']}" if r.get("epoch") is not None else "")
+            + ("  (" + ", ".join(extras) + ")" if extras else ""))
+        rows.append({k: r.get(k) for k in
+                     ("action", "processes", "epoch", "hosts", "step",
+                      "world_from", "shed", "ts")})
+    return rows
 
 
 def decode_section(records, out=print):
@@ -352,7 +404,7 @@ def summarize(records, out=print):
         status = ends[-1].get("status") or "ok"
         summary["run_end"] = {"status": status, "steps": ends[-1]["steps"],
                               "seconds": secs}
-        out(f"{'CRASHED' if status == 'crashed' else 'completed'}: "
+        out(f"{'CRASHED' if status == 'crashed' else 'PREEMPTED (snapshotted)' if status == 'preempted' else 'completed'}: "
             f"{ends[-1]['steps']} steps in "
             + (f"{secs:.1f}s" if secs is not None else "?s")
             + "".join(f" {k}={v}" for k, v in ends[-1].items()
@@ -369,6 +421,9 @@ def summarize(records, out=print):
     # remediation view (parallel.supervisor lineage): per-attempt failure
     # classes, injected-vs-organic faults, crash-loop banner
     summary["restarts"] = restarts_section(records, out=out)
+    # elastic-capacity timeline (round 13): shrink -> degraded attempts ->
+    # re-expansion, preemption snapshots, peer restores, serve drains
+    summary["elasticity"] = elasticity_section(records, out=out)
 
     if steps:
         # warm records carry the XLA compile in dispatch_s; exclude them
@@ -560,6 +615,22 @@ def main(argv=None) -> int:
             records.extend(read_ledger(p, strict=False))
         except OSError as e:
             print(f"warning: skipping {p}: {e}", file=sys.stderr)
+    if not args.no_discover:
+        # the supervisor's own scale-event sibling (parallel.supervisor
+        # elasticity decisions): APPENDED to the stream, never
+        # ts-interleaved — a between-attempt scale event sorted into the
+        # middle would split a pseudo-attempt into the run_start-boundary
+        # goodput/restart math. The elasticity section orders by ts itself.
+        import re
+
+        root, ext = os.path.splitext(paths[0])
+        root = re.sub(r"\.a\d+$", "", root)  # any attempt path -> the stem
+        sup = f"{root}.sup{ext}"
+        if os.path.exists(sup):
+            try:
+                records.extend(read_ledger(sup, strict=False))
+            except OSError as e:
+                print(f"warning: skipping {sup}: {e}", file=sys.stderr)
     if not records:
         print(f"{args.path}: empty ledger", file=sys.stderr)
         return 1

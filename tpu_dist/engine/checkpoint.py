@@ -309,6 +309,35 @@ def save_checkpoint(ckpt_dir: str, state, epoch: int, best_acc1: float,
     return path
 
 
+def peer_restore_state(state, broadcast=None) -> Tuple[Any, bool]:
+    """Checkpoint-less dp-pure recovery (round 13): adopt process 0's
+    state over a broadcast collective instead of the disk round-trip.
+
+    On mesh re-expansion a returning host has no (or a stale) local
+    checkpoint, but every survivor holds the live replicated state — and
+    the consensus renumbering (parallel.consensus, survivors-first)
+    guarantees process 0 IS a survivor. All processes must call this
+    (the broadcast is collective; the distributed analog of the
+    reference's ring-allreduce variant 5). Returns
+    ``(host_state, True)`` after a broadcast, or ``(state, False)``
+    untouched on a single process — callers re-place the result with
+    their mode's sharding. Only valid for REPLICATED (dp-pure) states:
+    sharded layouts must take the disk path, whose container knows the
+    global layout.
+
+    ``broadcast`` is injectable for tests; the default is
+    ``multihost_utils.broadcast_one_to_all`` (source = process 0).
+    """
+    if jax.process_count() <= 1 and broadcast is None:
+        return state, False
+    if broadcast is None:
+        from jax.experimental import multihost_utils
+
+        broadcast = multihost_utils.broadcast_one_to_all
+    host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), state)
+    return broadcast(host), True
+
+
 def read_checkpoint_meta(path: str) -> Dict:
     """Metadata only, without deserializing the blob — validate geometry
     BEFORE from_bytes (whose structure-mismatch errors are opaque).

@@ -45,6 +45,7 @@ from tpu_dist.obs import (HealthError, RunObs, faults, profile_session,
                           step_annotation)
 from tpu_dist.ops import lm_lr_schedule, make_optimizer, make_policy
 from tpu_dist.parallel.mesh import make_mesh, replicated
+from tpu_dist.parallel.supervisor import PREEMPT_SNAPSHOT_RC
 from tpu_dist.utils.meters import MeterBank
 
 
@@ -342,6 +343,28 @@ class LMTrainer:
                              f"{self._skip_batches} already-applied batches")
             self.log(f"=> resumed from {cfg.resume} "
                      f"(epoch {self.start_epoch})")
+        # checkpoint-less dp-pure recovery (round 13): on a supervisor
+        # mesh re-expansion (TPU_DIST_PEER_RESUME), adopt a survivor's
+        # live replicated state over a broadcast collective — the
+        # returning host has no local checkpoint, and the consensus
+        # renumbering keeps process 0 a survivor. Replicated layouts
+        # only; sharded modes take the disk path above.
+        self._dp_pure = not (self.use_sp or self.use_tp or self.use_ep
+                             or self.use_pp or cfg.fsdp)
+        self._peer_restored = False
+        if os.environ.get("TPU_DIST_PEER_RESUME") == "1" and self._dp_pure:
+            state, did = ckpt.peer_restore_state(state)
+            if did:
+                self._peer_restored = True
+                # epoch/skip re-derive from the adopted step counter, the
+                # same math as a mid-epoch resume (best_ppl is the one
+                # piece a joiner cannot recover — it only gates is_best)
+                step_done = int(np.asarray(state.step))
+                self.start_epoch = step_done // self.steps_per_epoch
+                self._skip_batches = step_done % self.steps_per_epoch
+                self.log(f"=> peer-restored state from a survivor at step "
+                         f"{step_done} (no disk round-trip); resuming "
+                         f"epoch {self.start_epoch}")
         self.state = self._place(state)
         self._epoch_in_progress = self.start_epoch
         self._flops_per_step = None  # analytical, lazily (utils.mfu)
@@ -738,8 +761,15 @@ class LMTrainer:
             data_s = time.time() - end
             meters.update("Data", data_s)
             gstep = epoch * self.steps_per_epoch + i
-            if "nan_batch" in self.obs.fire_step_faults(gstep):
+            effects = self.obs.fire_step_faults(gstep)
+            if "nan_batch" in effects:
                 self._apply_nan_fault()
+            if "preempt_deadline" in effects:
+                self.obs.request_preemption(
+                    deadline_s=effects["preempt_deadline"].args.get("secs"),
+                    source="fault")
+            if self.obs.preempt_pending():
+                self._preempt_snapshot(pending, meters)  # raises SystemExit
             was_cold = not self._warmed  # this dispatch carries the compile
             with step_annotation(gstep, self.obs.profiling), \
                     tr.span("dispatch"):
@@ -844,9 +874,16 @@ class LMTrainer:
         for n, idx_dev in windows:
             data_s = time.time() - end
             meters.update("Data", data_s / n, n)
-            if "nan_batch" in self.obs.fire_step_faults(
-                    epoch * self.steps_per_epoch + done):
+            effects = self.obs.fire_step_faults(
+                epoch * self.steps_per_epoch + done)
+            if "nan_batch" in effects:
                 self._apply_nan_fault()
+            if "preempt_deadline" in effects:
+                self.obs.request_preemption(
+                    deadline_s=effects["preempt_deadline"].args.get("secs"),
+                    source="fault")
+            if self.obs.preempt_pending():
+                self._preempt_snapshot(pending, meters)  # raises SystemExit
             was_cold = not self._warmed  # this dispatch carries the compile
             with step_annotation(epoch * self.steps_per_epoch + done,
                                  self.obs.profiling), tr.span("dispatch"):
@@ -919,6 +956,53 @@ class LMTrainer:
         make them, and the health sentry/policy takes it from there."""
         self.state = self.state.replace(
             params=faults.poison_params(self.state.params))
+
+    def _preempt_snapshot(self, pending=None, meters=None) -> None:
+        """Coordinated snapshot on preemption (round 13): the drain blocks
+        until the in-flight dispatched steps land, then a consistent
+        checkpoint commits through the CRC/keep-K container (the
+        collective gather inside save_checkpoint is the cross-host
+        barrier for sharded state) and the process exits ``PREEMPT_SNAPSHOT_RC`` — the supervisor
+        classifies ``preemption_snapshotted`` and the restart resumes
+        from THIS step, not the last periodic checkpoint."""
+        cfg = self.cfg
+        if pending:
+            self._drain(pending, meters)
+        self.obs.pause()  # the snapshot write is not a stall
+        # distlint: disable=DL002 -- preemption boundary: one scalar fetch after the final drain
+        step_done = int(jax.device_get(self.state.step))
+        try:
+            mesh_epoch = int(os.environ.get("TPU_DIST_MESH_EPOCH", "0") or 0)
+        except ValueError:
+            mesh_epoch = 0
+        if cfg.checkpoint_dir:
+            # cross-host consistency comes from save_checkpoint itself:
+            # sharded states gather via a COLLECTIVE (every live host
+            # blocks in it — the barrier), replicated dp state is in
+            # per-step lockstep so process 0's replica IS the global cut.
+            # No explicit sync_global_devices here: on a shrink-triggered
+            # SIGTERM the lost host would never arrive and the barrier
+            # would hang every survivor into its SIGKILL deadline.
+            t0_ck = time.time()
+            ckpt.save_checkpoint(
+                cfg.checkpoint_dir, self.state, self._epoch_in_progress,
+                0.0, "lm", is_best=False,
+                extra_meta={"mid_epoch": True, "preempt": True,
+                            "best_ppl": self.best_ppl, **self._run_meta},
+                keep=cfg.keep_checkpoints)
+            self.obs.ledger.emit(
+                "ckpt", epoch=self._epoch_in_progress,
+                path=cfg.checkpoint_dir, is_best=False,
+                seconds=round(time.time() - t0_ck, 6), preempt=True)
+        self.obs.ledger.emit(
+            "scale", action="preempt_snapshot",
+            processes=jax.process_count(), epoch=mesh_epoch, step=step_done)
+        self.log(f"preempted ({self.obs.preempt_source}, deadline "
+                 f"{self.obs.preempt_deadline_s}s): snapshot at step "
+                 f"{step_done} — exiting for supervised resume")
+        self.obs.run_end(status="preempted", snapshot_step=step_done,
+                         best_ppl=self.best_ppl)
+        raise SystemExit(PREEMPT_SNAPSHOT_RC)
 
     # ------------------------------------------------------------------
     def validate(self, epoch: int = 0):
@@ -1009,7 +1093,19 @@ class LMTrainer:
     def fit(self) -> float:
         """Returns best val perplexity."""
         cfg = self.cfg
+        # SIGTERM becomes a snapshot request this loop drains at its next
+        # step boundary (the coordinated-preemption contract)
+        self.obs.enable_preempt_snapshot()
         self.obs.run_start()
+        if self._peer_restored:
+            try:
+                mesh_epoch = int(
+                    os.environ.get("TPU_DIST_MESH_EPOCH", "0") or 0)
+            except ValueError:
+                mesh_epoch = 0
+            self.obs.ledger.emit(
+                "scale", action="peer_restore",
+                processes=jax.process_count(), epoch=mesh_epoch)
         if cfg.evaluate:
             try:
                 return self.validate(0)[1]
@@ -1066,6 +1162,9 @@ class LMTrainer:
         cfg = self.cfg
         for epoch in range(self.start_epoch, cfg.epochs):
             self._epoch_in_progress = epoch
+            if self.obs.preempt_pending():
+                # SIGTERM landed during the previous eval/checkpoint span
+                self._preempt_snapshot()
             t0 = time.time()
             train_metrics = self.train_epoch(epoch)
             train_secs = time.time() - t0

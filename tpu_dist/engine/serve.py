@@ -45,6 +45,8 @@ put them (single-process serving is the shape this PR pins down).
 
 from __future__ import annotations
 
+import signal
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -257,6 +259,13 @@ class ServeEngine:
         self._in_breach = False
         self.shedding = False
         self._last_kv_tick = 0
+        # graceful drain (round 13): a preemption SIGTERM finishes the
+        # in-flight sequences and sheds the rest instead of dying mid-tick
+        self._t_start = self._now()
+        self.draining = False
+        self._drained = False
+        self._preempt_event = threading.Event()
+        self._prev_sigterm = None
 
     # -- admission --------------------------------------------------------
     def submit(self, req: DecodeRequest) -> bool:
@@ -273,6 +282,11 @@ class ServeEngine:
             return False
         if self.pool.pages_needed(total) > self.pool.num_pages:
             self._emit_admit(req, now, False, "exceeds_pool")
+            return False
+        if self.draining:
+            # a draining server takes nothing new: the caller's signal to
+            # retry elsewhere, same contract as SLO shedding
+            self._emit_admit(req, now, False, "shed")
             return False
         if self.shedding:
             self._emit_admit(req, now, False, "slo_shedding")
@@ -355,11 +369,19 @@ class ServeEngine:
 
     def run(self, requests=(), max_ticks: int = 100_000) -> List[Completion]:
         """Submit everything, then step until drained (tests, batch jobs).
-        Rejected submissions are simply absent from the completions."""
+        Rejected submissions are simply absent from the completions. A
+        preemption SIGTERM (:meth:`install_sigterm_drain`) switches to
+        :meth:`drain` at the next tick boundary instead of dying mid-tick."""
         for req in requests:
             self.submit(req)
         out: List[Completion] = []
         while self.queue or any(s is not None for s in self.slots):
+            if self._preempt_event.is_set() and not self.draining:
+                # drain() already emitted the final kv_cache + run_end;
+                # falling through to the normal-completion epilogue would
+                # double-emit the final pressure snapshot
+                out.extend(self.drain(reason="sigterm"))
+                return out
             out.extend(self.step())
             if self.ticks > max_ticks:
                 raise RuntimeError(
@@ -367,6 +389,61 @@ class ServeEngine:
                     f"({len(self.queue)} queued, "
                     f"{sum(s is not None for s in self.slots)} active)")
         self._emit_kv_cache()
+        return out
+
+    # -- graceful shutdown (round 13) -------------------------------------
+    def install_sigterm_drain(self):
+        """Route the scheduler's preemption SIGTERM into a graceful drain:
+        the handler only sets a flag (signal-safe — no jax, no locks), and
+        :meth:`run` drains at its next tick boundary. Main thread only;
+        returns an uninstall callable."""
+        prev = signal.signal(signal.SIGTERM,
+                             lambda signum, frame: self._preempt_event.set())
+        self._prev_sigterm = prev
+
+        def uninstall():
+            signal.signal(signal.SIGTERM, prev)
+            self._prev_sigterm = None
+
+        return uninstall
+
+    def drain(self, reason: str = "sigterm",
+              max_ticks: int = 100_000) -> List[Completion]:
+        """Graceful shutdown: finish every IN-FLIGHT sequence (they hold
+        pages and partial generations — killing them wastes the work),
+        reject the whole queue with a ``shed`` admission record (the
+        caller's signal to retry elsewhere), free all pages via the normal
+        eviction path, and emit ``run_end`` so the ledger shows a drained
+        server, not a mid-tick corpse. Idempotent; returns the completions
+        of the in-flight sequences."""
+        if self._drained:
+            return []
+        self.draining = True
+        shed = list(self.queue)
+        self.queue.clear()
+        now = self._now()
+        for req, _enq_ts in shed:
+            self._emit_admit(req, now, False, "shed")
+        out: List[Completion] = []
+        t0_ticks = self.ticks
+        while any(s is not None for s in self.slots):
+            out.extend(self.step())
+            if self.ticks - t0_ticks > max_ticks:
+                raise RuntimeError(
+                    f"graceful drain exceeded {max_ticks} ticks with "
+                    f"{sum(s is not None for s in self.slots)} still active")
+        self._drained = True
+        self._emit_kv_cache()  # final pressure snapshot: all pages free
+        if self.ledger is not None:
+            self.ledger.emit(
+                "scale", action="drain", processes=1, epoch=None,
+                reason=reason, shed=len(shed), finished=len(out))
+            self.ledger.emit(
+                "run_end", steps=self.ticks,
+                seconds=round(self._now() - self._t_start, 6),
+                status="preempted", reason=reason,
+                completed=self.completed, rejected=self.rejected,
+                shed=len(shed))
         return out
 
     # -- internals --------------------------------------------------------
@@ -514,4 +591,5 @@ class ServeEngine:
                 "active_seqs": sum(s is not None for s in self.slots),
                 "wait_ema_s": self._wait_ema,
                 "shedding": self.shedding,
+                "draining": self.draining,
                 **self.pool.stats()}

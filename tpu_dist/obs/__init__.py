@@ -189,6 +189,41 @@ class RunObs:
         self._crash_tb: Optional[str] = None
         self._prev_excepthook = None
         self._prev_sigterm = None
+        # coordinated preemption (round 13): a loop that can snapshot
+        # enables this, and a SIGTERM then REQUESTS a snapshot (flag +
+        # deadline) instead of the crash guard's immediate run_end — the
+        # loop finishes the in-flight step, checkpoints, and exits with
+        # parallel.supervisor.PREEMPT_SNAPSHOT_RC
+        self._preempt_enabled = False
+        self._preempt_event = threading.Event()
+        self.preempt_deadline_s: Optional[float] = None
+        self.preempt_source: Optional[str] = None
+
+    # -- coordinated preemption ----------------------------------------
+    def enable_preempt_snapshot(self) -> None:
+        """Loops with a snapshot path call this before :meth:`run_start`:
+        SIGTERM becomes a snapshot REQUEST the loop drains at its next
+        step boundary rather than an immediate crash-guard shutdown."""
+        self._preempt_enabled = True
+
+    def request_preemption(self, deadline_s: Optional[float] = None,
+                           source: str = "sigterm") -> None:
+        """Arm the snapshot request (idempotent). ``deadline_s`` defaults
+        to the supervisor-forwarded ``TPU_DIST_PREEMPT_DEADLINE_S``."""
+        if self._preempt_event.is_set():
+            return
+        if deadline_s is None:
+            try:
+                deadline_s = float(
+                    os.environ.get("TPU_DIST_PREEMPT_DEADLINE_S", "30"))
+            except ValueError:
+                deadline_s = 30.0
+        self.preempt_deadline_s = deadline_s
+        self.preempt_source = source
+        self._preempt_event.set()
+
+    def preempt_pending(self) -> bool:
+        return self._preempt_event.is_set()
 
     # -- lifecycle ------------------------------------------------------
     def run_start(self) -> None:
@@ -208,6 +243,10 @@ class RunObs:
         except ValueError:
             fault_attempt = self.attempt
         faults.set_context(attempt=fault_attempt)
+        try:
+            mesh_epoch = int(os.environ.get("TPU_DIST_MESH_EPOCH", "0") or 0)
+        except ValueError:
+            mesh_epoch = 0
         self.ledger.emit(
             "run_start", kind=self.kind,
             config=dataclasses.asdict(self.cfg)
@@ -220,7 +259,11 @@ class RunObs:
             peak_is_nominal=self.peak_is_nominal,
             jax_version=jax.__version__,
             job_id=self.job_id, attempt=self.attempt,
-            resumed_from=getattr(self.cfg, "resume", "") or None)
+            resumed_from=getattr(self.cfg, "resume", "") or None,
+            # elastic lineage (parallel.consensus): reports tell a
+            # degraded layout and its rendezvous epoch from the planned one
+            degraded=os.environ.get("TPU_DIST_DEGRADED") == "1",
+            mesh_epoch=mesh_epoch)
         self._arm_crash_guard()
 
     def run_end(self, status: Optional[str] = None, **extra) -> None:
@@ -343,6 +386,12 @@ class RunObs:
                             if self._crash_tb else {}))
 
     def _on_sigterm(self, signum, frame) -> None:
+        if self._preempt_enabled and not self._ended:
+            # coordinated path: flag only (signal-safe — no locks, no
+            # I/O); the loop finishes the in-flight step, snapshots, and
+            # owns the run_end + exit
+            self.request_preemption(source="SIGTERM")
+            return
         # capture BEFORE run_end: disarming inside it nulls _prev_sigterm,
         # and a previously-installed handler (a preemption checkpoint
         # hook, say) must still be chained
@@ -399,12 +448,14 @@ class RunObs:
                              n_steps=steps_in_dispatch)
         return rec
 
-    def fire_step_faults(self, step: int) -> set:
+    def fire_step_faults(self, step: int) -> dict:
         """Step-scoped fault-injection check (obs.faults), called by the
         loops once per dispatch iteration: the process-level sites
-        (hard_exit/hang/preempt_sigterm) act inside, and the returned set
-        names the data-level effects the loop must apply itself (at most
-        ``{"nan_batch"}``). No-op and near-free when no plan is active."""
+        (hard_exit/hang/preempt_sigterm) act inside, and the returned
+        ``{site: Fault}`` mapping names the data-level effects the loop
+        must apply itself (``nan_batch``, ``preempt_deadline`` — the
+        Fault carries site args like the injected deadline). No-op and
+        near-free when no plan is active."""
         return faults.fire_step(step, ledger=self.ledger)
 
     def heartbeat(self) -> None:

@@ -27,7 +27,9 @@ conditions and site arguments:
   and resumes *before* the fault step);
 * ``times=K``  — fire up to K times (default 1; rendezvous faults use
   this to fail the first K connection attempts);
-* ``secs=S``   — ``hang`` only: sleep S seconds (default 3600);
+* ``secs=S``   — ``hang``: sleep S seconds (default 3600);
+  ``preempt_deadline``: the forwarded snapshot deadline (default the
+  ``TPU_DIST_PREEMPT_DEADLINE_S`` env, then 30);
 * ``code=C``   — ``hard_exit`` only: ``os._exit`` status (default 13).
 
 Sites (:data:`SITES`):
@@ -47,7 +49,15 @@ Sites (:data:`SITES`):
   the container write (engine.checkpoint), before any byte lands;
 * ``rendezvous_fail`` — ``launch.initialize`` raises ``ConnectionError``
   instead of calling ``jax.distributed.initialize`` (exercises the retry/
-  backoff/deadline path without a real flaky coordinator).
+  backoff/deadline path without a real flaky coordinator);
+* ``preempt_deadline``— step-scoped; the engine receives the scheduler's
+  advance preemption notice (``secs=S`` deadline, default 30) WITHOUT a
+  real SIGTERM: the loop finishes the in-flight step, writes the
+  coordinated snapshot and exits ``preemption_snapshotted`` — the
+  round-13 elastic path, provable on CPU;
+* ``host_return``     — consensus-round site (parallel.consensus): a lost
+  planned host re-registers (``host=N`` names one, default all missing),
+  driving mesh re-expansion deterministically with no real second host.
 
 Every injection emits one ``fault`` ledger event (EVENT_SCHEMA) — reports
 must distinguish *injected* failures from organic ones — and prints a
@@ -66,10 +76,12 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 SITES = ("nan_batch", "hard_exit", "hang", "preempt_sigterm",
-         "ckpt_enospc", "rendezvous_fail")
+         "ckpt_enospc", "rendezvous_fail", "preempt_deadline",
+         "host_return")
 
 # sites the engines check once per optimizer-step loop iteration
-STEP_SITES = ("nan_batch", "hard_exit", "hang", "preempt_sigterm")
+STEP_SITES = ("nan_batch", "hard_exit", "hang", "preempt_sigterm",
+              "preempt_deadline")
 
 # match conditions vs site arguments (anything not a condition is an arg)
 _CONDITIONS = ("step", "epoch", "nth", "attempt")
@@ -269,20 +281,24 @@ def fire(site: str, ledger=None, **ctx) -> Optional[Fault]:
     return plan.fire(site, ledger=ledger, **merged)
 
 
-def fire_step(step: int, ledger=None, **ctx) -> set:
-    """Check every step-scoped site for this step ordinal; returns the set
-    of data-level effects the caller must apply (currently at most
-    ``{"nan_batch"}`` — the process-level sites act inside fire())."""
+def fire_step(step: int, ledger=None, **ctx) -> Dict[str, Fault]:
+    """Check every step-scoped site for this step ordinal; returns the
+    data-level effects the caller must apply as ``{site: Fault}``
+    (``nan_batch`` and ``preempt_deadline`` — the Fault carries the
+    site args, e.g. the injected deadline's ``secs``; the process-level
+    sites act inside fire()). ``site in effects`` keeps working as it
+    did when this returned a bare set."""
     plan = active_plan()
     if plan is None:
-        return set()
-    effects = set()
+        return {}
+    effects: Dict[str, Fault] = {}
     active = plan.sites()
     for site in STEP_SITES:
-        if site in active and plan.fire(site, ledger=ledger,
-                                        **{**_context, "step": step, **ctx}):
-            if site == "nan_batch":
-                effects.add(site)
+        fault = (plan.fire(site, ledger=ledger,
+                           **{**_context, "step": step, **ctx})
+                 if site in active else None)
+        if fault is not None and site in ("nan_batch", "preempt_deadline"):
+            effects[site] = fault
     return effects
 
 

@@ -112,6 +112,13 @@ EVENT_SCHEMA = {
     # may be None for non-step-scoped sites. Reports use these to keep
     # injected failures distinguishable from organic ones
     "fault": ("site", "step", "spec"),
+    # elastic-capacity transition (parallel.supervisor consensus + the
+    # engines): action names the transition (shrink|expand|
+    # preempt_snapshot|peer_restore|drain), processes the post-transition
+    # world size, epoch the consensus/rendezvous epoch (None where no
+    # consensus is configured); hosts/step/world_from ride as extras.
+    # ledger_report stitches these into the elasticity timeline
+    "scale": ("action", "processes", "epoch"),
     # run rollup: total steps, wall seconds, best metric in extras;
     # status ("ok"|"crashed"|"interrupted") rides as an extra stamped by
     # RunObs.run_end — the crash-safe shutdown path sets "crashed"

@@ -376,13 +376,22 @@ def metrics_ledger_sink(reg: MetricsRegistry):
                                 "submissions rejected by admission control")
     serve_toks = reg.counter("tpu_dist_serve_tokens_total",
                              "tokens generated by the serving engine")
+    # elastic capacity (parallel.consensus / supervisor `scale` events):
+    # the live mesh size and the degraded flag, so a dashboard shows a
+    # shrink/re-expansion cycle without parsing ledgers
+    mesh_procs = reg.gauge("tpu_dist_mesh_processes",
+                           "process count of the current mesh (consensus "
+                           "view; from run_start and scale events)")
+    degraded_g = reg.gauge("tpu_dist_degraded",
+                           "1 while running on a shrunken (degraded) "
+                           "mesh, 0 at the planned world size")
     # materialize the unlabeled children too — a family with no child
     # renders no sample line, and "0" vs "absent" are different answers
     # to "is it hung?"
     for m in (steps, items, mfu, loss, stalls, stall_idle, skew_spread,
               straggler, epoch_g, eval_loss, hbm, decode_toks, step_hist,
               goodput_ratio, serve_queue, serve_active, kv_free, serve_reqs,
-              serve_rejects, serve_toks):
+              serve_rejects, serve_toks, mesh_procs, degraded_g):
         m.labels()
 
     def sink(rec: dict) -> None:
@@ -401,6 +410,9 @@ def metrics_ledger_sink(reg: MetricsRegistry):
                 jax=str(rec.get("jax_version") or ""),
                 quant=str(cfg.get("quant") or "none"),
                 tp_impl=str(cfg.get("tp_impl") or "gspmd")).set(1)
+            if rec.get("process_count") is not None:
+                mesh_procs.set(rec["process_count"])
+            degraded_g.set(1.0 if rec.get("degraded") else 0.0)
         elif ev == "step":
             last_step_ts[0] = rec.get("ts") or _time.time()
             n = rec.get("steps_in_dispatch") or 1
@@ -471,6 +483,14 @@ def metrics_ledger_sink(reg: MetricsRegistry):
                     badput.labels(category=c).set(secs)
         elif ev == "slo":
             slo_breaches.labels(kind=rec.get("kind") or "unknown").inc()
+        elif ev == "scale":
+            if rec.get("processes") is not None:
+                mesh_procs.set(rec["processes"])
+            act = rec.get("action")
+            if act == "shrink":
+                degraded_g.set(1.0)
+            elif act == "expand":
+                degraded_g.set(0.0)
 
     return sink
 

@@ -16,6 +16,7 @@ _LAZY = {
     "launch": None,
     "mesh": None,
     "collectives": None,
+    "consensus": None,
     "overlap": None,
     "supervisor": None,
     "fsdp": None,
@@ -23,6 +24,9 @@ _LAZY = {
     "ep": None,
     "pp": None,
     "ring_attention": None,
+    # parallel.consensus (jax-free, like supervisor)
+    "ConsensusDir": "consensus", "MeshView": "consensus",
+    "consensus_env": "consensus",
     # parallel.mesh
     "DATA_AXIS": "mesh", "FSDP_AXIS": "mesh", "MODEL_AXIS": "mesh",
     "SEQ_AXIS": "mesh", "batch_sharding": "mesh", "make_mesh": "mesh",

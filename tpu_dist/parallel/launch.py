@@ -49,14 +49,35 @@ def _slurm_first_host(nodelist: str) -> str:
     return prefix + first
 
 
+def epoch_coordinator(coordinator: str, epoch: int) -> str:
+    """Offset the coordinator port by the consensus mesh epoch
+    (``TPU_DIST_MESH_EPOCH``, parallel.consensus): every re-formed mesh
+    rendezvouses on a FRESH port, so a shrink/re-expansion relaunch never
+    reconnects to the previous epoch's half-dead coordination service —
+    the stale-coordinator hang the PR-10 rendezvous retries could only
+    time out of, not avoid. Pure; unparseable inputs pass through."""
+    if not coordinator or epoch <= 0 or ":" not in coordinator:
+        return coordinator
+    host, _, port = coordinator.rpartition(":")
+    try:
+        return f"{host}:{int(port) + epoch}"
+    except ValueError:
+        return coordinator
+
+
 def detect_launch(coordinator: Optional[str] = None,
                   num_processes: Optional[int] = None,
                   process_id: Optional[int] = None,
                   port: int = 8476) -> LaunchInfo:
     env = os.environ
     if coordinator or env.get("TPU_DIST_COORDINATOR"):
+        try:
+            epoch = int(env.get("TPU_DIST_MESH_EPOCH", "0") or 0)
+        except ValueError:
+            epoch = 0
         return LaunchInfo(
-            coordinator or env["TPU_DIST_COORDINATOR"],
+            epoch_coordinator(coordinator or env["TPU_DIST_COORDINATOR"],
+                              epoch),
             int(num_processes if num_processes is not None
                 else env.get("TPU_DIST_NUM_PROCESSES", "1")),
             int(process_id if process_id is not None
@@ -65,9 +86,23 @@ def detect_launch(coordinator: Optional[str] = None,
     if "SLURM_PROCID" in env and env.get("SLURM_NPROCS", "1") != "1":
         # reference 6.distributed_slurm_main.py:89-94: rank from SLURM_PROCID,
         # world from SLURM_NPROCS; file:// rendezvous becomes coordinator TCP.
+        # The tpu_dist consensus overrides (dense renumbering + epoch) must
+        # win over the static Slurm env: a supervisor relaunch after host
+        # loss exports shrunken TPU_DIST_* values while SLURM_* still
+        # describes the original allocation.
         host = _slurm_first_host(env.get("SLURM_JOB_NODELIST", "localhost"))
-        return LaunchInfo(f"{host}:{port}", int(env["SLURM_NPROCS"]),
-                          int(env["SLURM_PROCID"]), "slurm")
+        try:
+            epoch = int(env.get("TPU_DIST_MESH_EPOCH", "0") or 0)
+        except ValueError:
+            epoch = 0
+        return LaunchInfo(
+            epoch_coordinator(f"{host}:{port}", epoch),
+            int(num_processes if num_processes is not None
+                else env.get("TPU_DIST_NUM_PROCESSES")
+                or env["SLURM_NPROCS"]),
+            int(process_id if process_id is not None
+                else env.get("TPU_DIST_PROCESS_ID") or env["SLURM_PROCID"]),
+            "slurm")
     workers = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
     if len(workers) > 1 or env.get("MEGASCALE_COORDINATOR_ADDRESS"):
         return LaunchInfo(None, -1, -1, "tpu-metadata")

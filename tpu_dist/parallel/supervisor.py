@@ -32,6 +32,23 @@ in two flavors:
   lineage. Process-killing failures (``os._exit``, SIGKILL, host loss)
   need the subprocess flavor by construction.
 
+Round 13 makes the capacity ELASTIC, not just shrinking: with a
+``consensus`` directory configured (:mod:`tpu_dist.parallel.consensus`,
+file-based and jax-free like everything here), per-host supervisors agree
+on the live host set every rendezvous epoch — a mid-numbered host loss
+renumbers ``TPU_DIST_PROCESS_ID`` densely over the survivors instead of
+dying in ``restarts_exhausted`` (the old ``degraded_env`` KNOWN LIMIT),
+and a lost host re-registering bumps the epoch and relaunches the
+children at the restored world size (shrink is two-way). A preemption
+SIGTERM is forwarded into the child with a deadline
+(``TPU_DIST_PREEMPT_DEADLINE_S``): the engine finishes the in-flight
+step, writes a coordinated snapshot and exits
+``preemption_snapshotted`` (rc ``PREEMPT_SNAPSHOT_RC``), so the restart
+resumes from the pre-preemption step, not the last periodic checkpoint.
+Every transition lands as a ``scale`` ledger event in the supervisor's
+own ``<stem>.sup.jsonl`` sibling, which ``tools/ledger_report`` stitches
+into the elasticity timeline.
+
 Everything here is importable WITHOUT jax (``scripts/lint.sh`` runs the
 policy math on a bare host as a CI gate); the training child owns all
 device state. Deterministic fault injection for every path lives in
@@ -53,10 +70,16 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tpu_dist.obs.goodput import attempt_path, next_attempt_index
+from tpu_dist.parallel.consensus import ConsensusDir, MeshView, consensus_env
 
 # every attempt ends in exactly one of these
 FAILURE_CLASSES = ("clean", "health_halt", "stall", "preemption",
-                   "rendezvous", "crash")
+                   "preemption_snapshotted", "rendezvous", "crash")
+
+# the exit code of a preemption honored WITH a coordinated snapshot
+# (EX_TEMPFAIL: "try again later" — the engines exit with it after the
+# barriered checkpoint lands, so the restart resumes the exact step)
+PREEMPT_SNAPSHOT_RC = 75
 
 # ledger events that prove the run is making forward progress (the stall
 # event itself grows the ledger too — it must NOT reset the liveness clock)
@@ -86,15 +109,44 @@ class RestartPolicy:
     stall_timeout_s: float = 1800.0  # ledger/heartbeat silence -> SIGKILL
     stall_grace_s: float = 10.0     # after a watchdog 'stall' event lands
     shrink_on_host_loss: bool = True
+    # deterministic per-host backoff spread (fraction of the base wait):
+    # without it, N hosts restarting after one shared failure all sleep
+    # the SAME exponential schedule and stampede the rendezvous
+    # coordinator in lockstep on every retry
+    backoff_jitter: float = 0.5
+    # seconds the child gets between SIGTERM and SIGKILL to finish its
+    # in-flight step and write the coordinated preemption snapshot
+    preempt_deadline_s: float = 30.0
 
 
-def compute_backoff(restart_no: int, policy: RestartPolicy) -> float:
+def _jitter_u(host_id: int, restart_no: int) -> float:
+    """Deterministic uniform-ish [0, 1) from (host, restart): a tiny
+    integer hash, NOT random — the same host always picks the same
+    offset (reproducible runs), different hosts decorrelate, and the
+    restart ordinal keeps repeat collisions from re-aligning."""
+    x = (host_id * 2654435761 + restart_no * 40503 + 0x9E3779B9) \
+        & 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 2246822519) & 0xFFFFFFFF
+    x ^= x >> 13
+    return x / 2.0 ** 32
+
+
+def compute_backoff(restart_no: int, policy: RestartPolicy,
+                    host_id: Optional[int] = None) -> float:
     """Seconds to wait before restart #``restart_no`` (1-based):
-    exponential from ``backoff_base_s``, capped at ``backoff_max_s``."""
+    exponential from ``backoff_base_s``, capped at ``backoff_max_s``.
+    With a ``host_id``, a deterministic per-host jitter stretches the
+    wait by up to ``backoff_jitter`` x itself, de-synchronizing the
+    cross-host restart stampede; without one (report-side/unit callers)
+    the schedule is the bare exponential."""
     if restart_no <= 0:
         return 0.0
-    return min(policy.backoff_base_s * (2.0 ** (restart_no - 1)),
+    wait = min(policy.backoff_base_s * (2.0 ** (restart_no - 1)),
                policy.backoff_max_s)
+    if host_id is not None and policy.backoff_jitter > 0:
+        wait *= 1.0 + policy.backoff_jitter * _jitter_u(host_id, restart_no)
+    return wait
 
 
 def classify_attempt(records: List[dict], returncode: Optional[int] = None,
@@ -117,6 +169,11 @@ def classify_attempt(records: List[dict], returncode: Optional[int] = None,
     if returncode == 0 or (returncode is None and end is not None
                            and status in (None, "ok")):
         return "clean"
+    if status == "preempted" or returncode == PREEMPT_SNAPSHOT_RC:
+        # the preemption was HONORED: the engine finished its in-flight
+        # step and committed the coordinated snapshot before exiting, so
+        # the restart resumes the exact pre-preemption step
+        return "preemption_snapshotted"
     if "HealthError" in err or "health=halt" in err:
         return "health_halt"
     if ("SIGTERM" in err or status == "interrupted"
@@ -172,13 +229,14 @@ def degraded_env(env: Dict[str, str],
     tell a degraded layout from the planned one. Returns (env, survivors).
     Pure — unit-testable without processes.
 
-    KNOWN LIMIT: ``TPU_DIST_PROCESS_ID`` is NOT renumbered — each host's
-    supervisor only sees its own env, and closing an id hole left by a
-    mid-numbered host needs cross-host consensus (ROADMAP item 2's
-    remaining ambition). Until then the shrunken rendezvous re-forms
-    cleanly when the LOST host held the highest id (ids stay dense) and
-    for the 1-survivor case every test exercises; a mid-host loss still
-    ends in a bounded restarts_exhausted instead of a hang."""
+    NOTE: ``TPU_DIST_PROCESS_ID`` is NOT renumbered here — this is the
+    consensus-LESS fallback (no shared dir configured), where each host's
+    supervisor only sees its own env. It re-forms cleanly when the LOST
+    host held the highest id (ids stay dense) and for the 1-survivor
+    case. Closing a MID-numbered id hole needs the cross-host agreement
+    of :mod:`tpu_dist.parallel.consensus` (round 13): with a
+    ``--consensus-dir``, :func:`consensus_env` renumbers densely over the
+    agreed survivor order and this function never runs."""
     n = int(env.get("TPU_DIST_NUM_PROCESSES", "1") or 1)
     survivors = max(n - max(lost, 0), 1)
     out = dict(env)
@@ -294,7 +352,9 @@ class Supervisor:
                  policy: Optional[RestartPolicy] = None,
                  env: Optional[Dict[str, str]] = None,
                  forward_flags: bool = True, poll_s: float = 0.25,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 consensus: Optional[ConsensusDir] = None,
+                 consensus_poll_s: float = 1.0):
         if not cmd:
             raise ValueError("supervisor needs a training command "
                              "(everything after '--')")
@@ -310,9 +370,90 @@ class Supervisor:
         self.poll_s = poll_s
         self._sleep = sleep
         self.degraded = False
+        # elastic consensus (round 13): cross-host membership + dense
+        # renumbering; None keeps the PR-10 single-host fallback paths
+        self.consensus = consensus
+        self.consensus_poll_s = consensus_poll_s
+        try:
+            self.host_id = (consensus.host_id if consensus is not None else
+                            int(self.env.get("TPU_DIST_PROCESS_ID", "0")
+                                or 0))
+        except ValueError:
+            self.host_id = 0
+        self._view: Optional[MeshView] = None   # the view the child runs at
+        self._scale_relaunch = False            # WE ended the attempt to
+        self._peer_resume_next = False          # rescale, not a failure
+        self._scale_ledger = None
 
     def _log(self, msg: str) -> None:
         print(f"[supervise] {msg}", file=sys.stderr, flush=True)
+
+    # -- scale events (the supervisor's own ledger sibling) --------------
+    def _ensure_scale_ledger(self):
+        """Lazily open ``<stem>.sup.jsonl`` — the supervisor's own ledger
+        (obs.ledger is stdlib-only, so this stays jax-free);
+        ledger_report merges it into the job timeline."""
+        if self._scale_ledger is None:
+            from tpu_dist.obs.ledger import Ledger
+
+            root, ext = os.path.splitext(self.ledger)
+            try:
+                self._scale_ledger = Ledger(f"{root}.sup{ext}")
+            except OSError as e:
+                self._log(f"warning: no scale ledger ({e})")
+                self._scale_ledger = False
+        return self._scale_ledger or None
+
+    def _emit_scale(self, action: str, processes: int,
+                    epoch: Optional[int], **extra) -> None:
+        self._ensure_scale_ledger()
+        if self._scale_ledger:
+            try:
+                self._scale_ledger.emit("scale", action=action,
+                                        processes=processes, epoch=epoch,
+                                        **extra)
+            except Exception as e:
+                self._log(f"warning: scale event dropped ({e})")
+
+    def _resolve_view(self) -> Optional[MeshView]:
+        """One consensus round + the env/flag fallout: dense renumbering,
+        degraded marking, shrink/expand scale events, and the one-shot
+        peer-resume marker for a re-expansion relaunch."""
+        if self.consensus is None:
+            return None
+        if self.consensus.fault_ledger is None:
+            # a host_return injection must leave its `fault` event on the
+            # record (injected-vs-organic accounting) — route it into the
+            # scale-event sibling
+            self.consensus.fault_ledger = self._ensure_scale_ledger()
+        view = self.consensus.resolve()
+        prev = self._view
+        self.env = consensus_env(self.env, view, self.host_id)
+        self.degraded = view.degraded
+        if prev is None or view.epoch != prev.epoch:
+            whence = f"{prev.world_size}->" if prev is not None else ""
+            self._log(f"consensus epoch {view.epoch}: "
+                      f"{whence}{view.world_size}/{view.planned} host(s) "
+                      f"{list(view.hosts)} (process "
+                      f"{view.process_id(self.host_id)} here)"
+                      + (" DEGRADED" if view.degraded else ""))
+        # transitions key on WORLD-SIZE changes, not degraded-flag edges:
+        # a second loss while already degraded (3->2) is still a shrink,
+        # and one of two lost hosts returning (2->3, still short of plan)
+        # is still an expansion that needs the peer-resume relaunch
+        world_from = prev.world_size if prev is not None else view.planned
+        if view.world_size < world_from:
+            self._emit_scale("shrink", view.world_size, view.epoch,
+                             hosts=list(view.hosts), world_from=world_from)
+        elif view.world_size > world_from:
+            self._emit_scale("expand", view.world_size, view.epoch,
+                             hosts=list(view.hosts), world_from=world_from)
+            # the grown world: a returning host has no local checkpoint,
+            # so dp-pure engines pull state from a survivor over the wire
+            # (engine.checkpoint.peer_restore_state)
+            self._peer_resume_next = True
+        self._view = view
+        return view
 
     # -- one attempt ----------------------------------------------------
     def _child_argv(self, resume: Optional[str]) -> List[str]:
@@ -343,10 +484,33 @@ class Supervisor:
             last_progress = time.monotonic()
             stall_confirmed: Optional[float] = None
             killed_for_stall = False
+            scale_term = False
+            launch_view = self._view
+            last_consensus = time.monotonic()
             hb_mtime = 0.0
             while proc.poll() is None:
                 self._sleep(self.poll_s)
                 now = time.monotonic()
+                if (self.consensus is not None
+                        and now - last_consensus >= self.consensus_poll_s):
+                    # heartbeat our membership + watch for an epoch bump
+                    # while the child runs: a returning host (or a further
+                    # loss) re-forms the mesh NOW, not at the next crash
+                    last_consensus = now
+                    view = self._resolve_view()
+                    if (launch_view is not None and view is not None
+                            and view.epoch != launch_view.epoch):
+                        grow = view.world_size > launch_view.world_size
+                        self._log(
+                            f"mesh epoch {launch_view.epoch} -> "
+                            f"{view.epoch} mid-attempt "
+                            f"({'re-expansion' if grow else 'shrink'} to "
+                            f"{view.world_size}) — SIGTERM for snapshot, "
+                            "then relaunch at the new world size")
+                        self._scale_relaunch = True
+                        scale_term = True
+                        proc.terminate()
+                        break
                 progressed = False
                 for ev in ledger_tail.poll():
                     if ev in _PROGRESS_EVENTS:
@@ -380,18 +544,29 @@ class Supervisor:
                     killed_for_stall = True
                     proc.kill()
                     break
-            rc = proc.wait()
+            if scale_term:
+                # graceful rescale: the child gets the preemption deadline
+                # to finish its in-flight step and commit the coordinated
+                # snapshot (it exits PREEMPT_SNAPSHOT_RC), then SIGKILL
+                try:
+                    rc = proc.wait(timeout=pol.preempt_deadline_s)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    rc = proc.wait()
+            else:
+                rc = proc.wait()
         finally:
             # the supervisor must NEVER orphan a live trainer: a dying
             # supervisor (SIGTERM'd by the scheduler — run() converts it
             # to SystemExit so this unwinds — or any internal error)
             # would otherwise leave the child racing its own requeue on
-            # the same ledger + checkpoint dir. SIGTERM first (the crash
-            # guard gets its run_end), SIGKILL if it lingers.
+            # the same ledger + checkpoint dir. SIGTERM first (the child
+            # snapshots within the forwarded preemption deadline, or at
+            # minimum the crash guard gets its run_end), SIGKILL after.
             if proc.poll() is None:
                 proc.terminate()
                 try:
-                    proc.wait(timeout=5.0)
+                    proc.wait(timeout=max(5.0, pol.preempt_deadline_s))
                 except subprocess.TimeoutExpired:
                     proc.kill()
                     proc.wait()
@@ -415,6 +590,11 @@ class Supervisor:
         try:
             return self._run_policy_loop()
         finally:
+            if self.consensus is not None:
+                # explicit deregistration: peers see this host's loss NOW
+                # (clean finish or our own preemption) instead of waiting
+                # out the membership lease
+                self.consensus.leave()
             if prev_term is not None:
                 signal.signal(signal.SIGTERM, prev_term)
 
@@ -433,11 +613,23 @@ class Supervisor:
             attempt_no = len(attempts)
             ordinal = next_attempt_index(self.ledger)
             attempt_file = attempt_path(self.ledger, ordinal)
+            # the consensus round: dense renumbering + degraded marking
+            # land in self.env BEFORE the child env is derived from it
+            self._resolve_view()
             resume = (latest_checkpoint(self.ckpt_dir)
                       if self.ckpt_dir else None)
             argv = self._child_argv(resume)
             env = dict(self.env)
             env["TPU_DIST_ATTEMPT"] = str(attempt_no)
+            env["TPU_DIST_PREEMPT_DEADLINE_S"] = str(pol.preempt_deadline_s)
+            if self._peer_resume_next:
+                # one relaunch only: the re-expansion attempt pulls state
+                # from a survivor over the wire where its local disk has
+                # no (or a stale) checkpoint
+                env["TPU_DIST_PEER_RESUME"] = "1"
+                self._peer_resume_next = False
+            else:
+                env.pop("TPU_DIST_PEER_RESUME", None)
             hb_file = attempt_file + ".hb"
             env["TPU_DIST_HEARTBEAT_FILE"] = hb_file
             self._log(f"attempt {attempt_no}: {' '.join(argv)}"
@@ -455,6 +647,13 @@ class Supervisor:
             attempts.append(result)
             self._log(f"attempt {attempt_no} ended: rc={rc} class={cls} "
                       f"({steps} step record(s) in {result.seconds:.1f}s)")
+            if self._scale_relaunch:
+                # WE ended this attempt to re-form the mesh at a new
+                # epoch: not a failure — no restart budget, no backoff,
+                # no crash-loop accounting; relaunch immediately
+                self._scale_relaunch = False
+                self._log("rescale relaunch (no restart budget consumed)")
+                continue
             if cls == "clean":
                 return SupervisorResult("clean", attempts)
             consecutive_dead = consecutive_dead + 1 if steps == 0 else 0
@@ -471,8 +670,12 @@ class Supervisor:
                 return SupervisorResult("restarts_exhausted", attempts)
             # shrink only on the SECOND consecutive rendezvous failure:
             # the first full-size retry rides out a transient coordinator
-            # outage (the common case); a repeat is the host-loss signal
-            if cls == "rendezvous" and pol.shrink_on_host_loss:
+            # outage (the common case); a repeat is the host-loss signal.
+            # Consensus-less fallback only — with a shared dir, membership
+            # (lease expiry / explicit leave) is the loss signal and
+            # _resolve_view owns sizing
+            if (cls == "rendezvous" and pol.shrink_on_host_loss
+                    and self.consensus is None):
                 rdzv_streak = 0
                 for a in reversed(attempts):
                     if a.failure_class != "rendezvous":
@@ -487,10 +690,24 @@ class Supervisor:
                                   f"mesh dp-only on {survivors} surviving "
                                   "process(es)")
             restarts += 1
-            wait = compute_backoff(restarts, pol)
+            # per-host jitter: N hosts restarting after one shared failure
+            # must not hit the rendezvous coordinator in lockstep
+            wait = compute_backoff(restarts, pol, host_id=self.host_id)
             self._log(f"restart {restarts}/{pol.max_restarts} in "
                       f"{wait:.1f}s")
-            self._sleep(wait)
+            if self.consensus is None:
+                self._sleep(wait)
+            else:
+                # heartbeat THROUGH the backoff: a capped backoff (60s+)
+                # dwarfs the membership lease (10s), and a silently
+                # sleeping host would be declared lost by its peers —
+                # one crash-looping host must not shrink a healthy mesh
+                remaining = wait
+                slice_s = max(self.consensus.lease_s / 3.0, 0.1)
+                while remaining > 0:
+                    self._sleep(min(remaining, slice_s))
+                    remaining -= slice_s
+                    self.consensus.register()
 
 
 # -- in-process library API (the engines' config opt-in) --------------------

@@ -50,6 +50,28 @@ def build_parser() -> argparse.ArgumentParser:
                     "to the command (it manages its own lineage)")
     ap.add_argument("--no-shrink", action="store_true",
                     help="never shrink the mesh on rendezvous/host loss")
+    ap.add_argument("--backoff-jitter", type=float,
+                    default=p.backoff_jitter,
+                    help="deterministic per-host restart-backoff spread "
+                    "(fraction of the wait; de-stampedes the coordinator)")
+    ap.add_argument("--preempt-deadline-s", type=float,
+                    default=p.preempt_deadline_s,
+                    help="seconds the child gets between SIGTERM and "
+                    "SIGKILL to write its coordinated preemption snapshot")
+    ap.add_argument("--consensus-dir", default="",
+                    help="shared directory for cross-host supervisor "
+                    "consensus (parallel.consensus): dense process-id "
+                    "renumbering on host loss + mesh re-expansion when a "
+                    "host returns; empty = single-host fallback behavior")
+    ap.add_argument("--host-id", type=int, default=None,
+                    help="this host's consensus id (default: "
+                    "TPU_DIST_PROCESS_ID)")
+    ap.add_argument("--planned-processes", type=int, default=None,
+                    help="the job's full world size (default: "
+                    "TPU_DIST_NUM_PROCESSES)")
+    ap.add_argument("--lease-s", type=float, default=10.0,
+                    help="consensus membership lease: a host whose "
+                    "heartbeat ages past this is declared lost")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="-- then the training command")
     return ap
@@ -65,10 +87,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         backoff_max_s=args.backoff_max_s, crash_loop_k=args.crash_loop_k,
         stall_timeout_s=args.stall_timeout_s,
         stall_grace_s=args.stall_grace_s,
-        shrink_on_host_loss=not args.no_shrink)
+        shrink_on_host_loss=not args.no_shrink,
+        backoff_jitter=args.backoff_jitter,
+        preempt_deadline_s=args.preempt_deadline_s)
+    consensus = None
+    if args.consensus_dir:
+        import os
+
+        from tpu_dist.parallel.consensus import ConsensusDir
+
+        host_id = (args.host_id if args.host_id is not None else
+                   int(os.environ.get("TPU_DIST_PROCESS_ID", "0") or 0))
+        planned = (args.planned_processes if args.planned_processes
+                   is not None else
+                   int(os.environ.get("TPU_DIST_NUM_PROCESSES", "1") or 1))
+        consensus = ConsensusDir(args.consensus_dir, host_id=host_id,
+                                 planned=planned, lease_s=args.lease_s)
+        # startup join barrier: the first epoch should be the full mesh,
+        # not a racey one-host view per supervisor start order
+        consensus.wait_for_peers()
     sup = Supervisor(cmd, ledger=args.ledger, ckpt_dir=args.ckpt_dir,
                      policy=policy,
-                     forward_flags=not args.no_forward_flags)
+                     forward_flags=not args.no_forward_flags,
+                     consensus=consensus)
     result: SupervisorResult = sup.run()
     print(f"[supervise] {result.status}: {len(result.attempts)} attempt(s) "
           + ", ".join(f"a{a.attempt}={a.failure_class}"
