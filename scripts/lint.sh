@@ -94,7 +94,26 @@ with tempfile.TemporaryDirectory() as d:
     view = hosts[0].resolve()
     assert view.epoch == 2 and view.hosts == (0, 2, 1)  # survivors first
     assert not view.degraded and view.process_id(1) == 2
-print("supervisor + consensus policy gates: OK (no jax)")
+
+# fleet-scenario gate (round 14): the schedule grammar + deterministic
+# compiler and the fleet stitcher must import and run jax-free — the CI
+# scenario is validated and its compile double-checked for determinism
+from tpu_dist.sim.scenario import (compile_host_plans, expected_restart_classes,
+                                   load_scenario)
+from tpu_dist.sim.fleet import FleetLedger
+
+sc = load_scenario("scripts/fleet_ci.json")
+p1, a1 = compile_host_plans(sc)
+p2, a2 = compile_host_plans(sc)
+assert ([ (x.tick, x.rid, x.tenant, x.prompt_len, x.out_len)
+          for h in sorted(p1) for x in p1[h].arrivals ] ==
+        [ (x.tick, x.rid, x.tenant, x.prompt_len, x.out_len)
+          for h in sorted(p2) for x in p2[h].arrivals ]) and a1 == a2
+assert {h: p.faults for h, p in p1.items() if p.faults}  # >= 1 fault wave
+classes = expected_restart_classes(sc)
+assert all(cls[-1] == "clean" for cls in classes.values())
+assert FleetLedger({0: []}).hosts == {0: []}
+print("supervisor + consensus + fleet-scenario policy gates: OK (no jax)")
 EOF
 
 # Advisory tier-1 budget creep warning (never fails the gate): conftest

@@ -22,3 +22,10 @@ def emit_serving_badly(ledger):
 def emit_scale_badly(ledger):
     # round 13: the elasticity event without its world size / epoch
     ledger.emit("scale", action="expand")
+
+
+def emit_fleet_badly(ledger):
+    # round 14: the fleet-simulation events (tpu_dist.sim.runner) are
+    # schema-checked like the rest
+    ledger.emit("scenario", name="ci")               # missing seed/hosts/ticks
+    ledger.emit("fleet", hosts_live=3)               # missing ratio/breaches

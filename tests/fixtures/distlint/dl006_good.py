@@ -37,3 +37,14 @@ def emit_scale_well(ledger):
                 hosts=[0, 2], world_from=3)
     ledger.emit("scale", action="preempt_snapshot", processes=1, epoch=0,
                 step=20)
+
+
+def emit_fleet_well(ledger):
+    # round 14: the fleet-simulation events (tpu_dist.sim.runner) —
+    # scenario identity + periodic/final fleet rollups
+    ledger.emit("scenario", name="ci", seed=7, hosts=3, ticks=200,
+                tick_s=0.02)
+    ledger.emit("fleet", hosts_live=3, goodput_ratio=None,
+                slo_breaches=None, final=False)
+    ledger.emit("fleet", hosts_live=0, goodput_ratio=0.31, slo_breaches=4,
+                final=True)

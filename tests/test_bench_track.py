@@ -127,3 +127,46 @@ def test_json_output_and_skipped_files(tmp_path, capsys):
 
 def test_no_usable_points_is_distinct_error(tmp_path):
     assert main(["--dir", str(tmp_path)]) == 2
+
+
+def test_fleet_goodput_gates_and_abstains_on_pre_fleet_history(tmp_path):
+    """The round-14 fleet gate: `fleet.goodput_ratio` is judged like the
+    headline (higher is better, threshold_pct) against the best prior
+    point CARRYING a fleet block — the pre-fleet BENCH history abstains,
+    exactly the data_s / serving.requests_per_tick convention."""
+    d = str(tmp_path)
+    _write_round(d, 1, 1000.0)                      # pre-fleet: no block
+    _write_round(d, 2, 1000.0, fleet={"goodput_ratio": 0.40})
+    paths = [os.path.join(d, f) for f in sorted(os.listdir(d))]
+    points = load_points(paths)
+    assert [p["fleet_goodput"] for p in points] == [None, 0.40]
+    m = track(points, threshold_pct=5.0)["metrics"][HEADLINE]
+    # one fleet point: nothing prior to judge against — abstain, ok
+    assert m["fleet_latest"] == 0.40 and m["fleet_best_prior"] is None
+    assert not m["fleet_regressed"]
+    # a regressed ratio fails the gate even with the headline value flat
+    _write_round(d, 3, 1000.0, fleet={"goodput_ratio": 0.30})  # -25%
+    report = track(load_points(paths + [os.path.join(d, "BENCH_r03.json")]),
+                   threshold_pct=5.0)
+    m = report["metrics"][HEADLINE]
+    assert m["fleet_regressed"] and not report["ok"]
+    assert main(["--dir", d, "--check"]) == 1
+    # inside the threshold: ok again
+    _write_round(d, 3, 1000.0, fleet={"goodput_ratio": 0.395})  # -1.3%
+    assert main(["--dir", d, "--check"]) == 0
+
+
+def test_fleet_headline_from_the_sim_runner_shape(tmp_path, capsys):
+    """The runner's headline.json (metric fleet_sim_goodput + fleet
+    block) loads as a first point and renders the no-history abstention."""
+    d = str(tmp_path)
+    path = os.path.join(d, "headline.json")
+    with open(path, "w") as f:
+        json.dump({"metric": "fleet_sim_goodput", "value": 0.31,
+                   "unit": "ratio",
+                   "fleet": {"goodput_ratio": 0.31, "slo_breaches": 4,
+                             "hosts": 3}}, f)
+    assert main([path]) == 0
+    out = capsys.readouterr().out
+    assert "fleet_sim_goodput" in out
+    assert "no prior fleet history" in out

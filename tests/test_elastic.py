@@ -439,6 +439,13 @@ def test_preempt_deadline_snapshot_resumes_exact_step(tmp_path):
     assert "preemption snapshot" in "\n".join(lines)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 14): near-duplicate of the
+# supervisor-driven snapshot path — the same SIGTERM -> in-flight-step ->
+# coordinated-snapshot -> rc 75 contract is pinned in-budget by
+# test_preempt_deadline_snapshot_resumes_exact_step (this twin only swaps
+# who sends the signal), and the fleet acceptance
+# (test_fleet.py::test_fleet_ci_scenario_acceptance) SIGTERMs real serve
+# workers on every rescale
 def test_sigterm_during_run_is_honored_with_snapshot(tmp_path):
     """The real signal path, no supervisor: SIGTERM to a training child
     mid-epoch produces the coordinated snapshot + rc 75 (the crash guard's

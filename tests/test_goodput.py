@@ -572,6 +572,12 @@ def test_two_attempt_lm_smoke_goodput_slo_flightrec(tmp_path):
     assert starts[1]["resumed_from"] == cfg2.resume
 
 
+@pytest.mark.slow  # tier-1 budget (PR 14): the serve-trace-replay
+# mechanics this CLI drives are pinned in-budget at the engine level
+# (test_serve.py continuous-vs-static schedule math) and end to end by the
+# fleet acceptance (test_fleet.py::test_fleet_ci_scenario_acceptance),
+# which replays Poisson traffic through the same ServeEngine across three
+# supervised processes
 def test_decode_bench_trace_replay_cli(tmp_path):
     """The throughput-under-load acceptance pin, on the real CLI surface:
     `decode_bench --trace` replays one seeded Poisson trace through the

@@ -34,6 +34,10 @@ def _run(cfg):
     return tr
 
 
+@pytest.mark.slow  # tier-1 budget (PR 14): the windowed-vs-per-batch
+# parity stays pinned in-budget by
+# test_lm_shard_mode_windowed_matches_per_batch (same parity, run under
+# the sharded step builders this bare-jit twin is a subset of)
 def test_lm_windowed_matches_per_batch(tmp_path):
     """steps_per_dispatch=4 + HBM-resident rows == the per-batch loop,
     parameter for parameter (same rng fold per optimizer step)."""

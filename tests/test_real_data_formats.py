@@ -144,6 +144,10 @@ def test_trainer_fit_over_real_mnist_idx(tmp_path):
     _fit_through(tmp_path, "mnist", write, "lenet", epochs=1)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 14): the imagefolder DECODE path is
+# pinned in-budget by test_imagefolder_format, and the trainer-over-real-
+# files mechanics by test_trainer_fit_over_real_cifar_pickles — this
+# variant only swaps which on-disk format feeds the same fit loop
 def test_trainer_fit_over_real_imagefolder(tmp_path):
     PIL = pytest.importorskip("PIL.Image")
 

@@ -90,6 +90,11 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
         # wall req/s rides the same block but carries machine variance
         serving = (parsed.get("serving")
                    if isinstance(parsed.get("serving"), dict) else {})
+        # fleet simulation (tpu_dist.sim, round 14+): the stitched fleet
+        # goodput ratio is the gated end-to-end number; history without a
+        # fleet block abstains, exactly the data_s/serving convention
+        fleet = (parsed.get("fleet")
+                 if isinstance(parsed.get("fleet"), dict) else {})
         points.append({
             "metric": parsed["metric"],
             "value": value,
@@ -98,6 +103,7 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
             "vs_baseline": parsed.get("vs_baseline"),
             "data_s": data_s,
             "serving_rpt": serving.get("requests_per_tick"),
+            "fleet_goodput": fleet.get("goodput_ratio"),
             "round": rnd,
             "file": os.path.basename(path),
         })
@@ -151,6 +157,17 @@ def track(points: List[dict], threshold_pct: float,
         srv_regressed = (srv_best is not None and srv_latest is not None
                          and (srv_best - srv_latest) / srv_best * 100.0
                          > threshold_pct)
+        # fleet goodput ratio (tpu_dist.sim): higher is better, judged
+        # against the best prior point CARRYING a fleet block — pre-fleet
+        # history abstains, exactly the data_s/serving convention
+        prior_fleet = [p["fleet_goodput"] for p in prior
+                       if p.get("fleet_goodput") is not None]
+        fleet_best = max(prior_fleet, default=None)
+        fleet_latest = latest.get("fleet_goodput")
+        fleet_regressed = (fleet_best is not None
+                           and fleet_latest is not None
+                           and (fleet_best - fleet_latest) / fleet_best
+                           * 100.0 > threshold_pct)
         rounds = [{"round": p["round"], "value": p["value"],
                    "mfu": p["mfu"], "file": p["file"],
                    "data_s": p.get("data_s"),
@@ -168,8 +185,11 @@ def track(points: List[dict], threshold_pct: float,
             "serving_latest": srv_latest,
             "serving_best_prior": srv_best,
             "serving_regressed": srv_regressed,
+            "fleet_latest": fleet_latest,
+            "fleet_best_prior": fleet_best,
+            "fleet_regressed": fleet_regressed,
         }
-        if regressed or data_regressed or srv_regressed:
+        if regressed or data_regressed or srv_regressed or fleet_regressed:
             report["ok"] = False
     return report
 
@@ -212,6 +232,17 @@ def render(report: dict, out=print) -> None:
             else:
                 out(f"  -> serving: {m['serving_latest']:.4f} req/tick "
                     "(no prior serving history; nothing to judge)")
+        if m.get("fleet_latest") is not None:
+            if m.get("fleet_best_prior") is not None:
+                verdict = ("FLEET REGRESSED" if m["fleet_regressed"]
+                           else "ok")
+                out(f"  -> fleet {verdict}: goodput ratio "
+                    f"{m['fleet_latest']:.4f} vs best prior "
+                    f"{m['fleet_best_prior']:.4f} (threshold "
+                    f"{report['threshold_pct']:g}%)")
+            else:
+                out(f"  -> fleet: goodput ratio {m['fleet_latest']:.4f} "
+                    "(no prior fleet history; nothing to judge)")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -266,7 +297,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if (args.check or args.headline) and not report["ok"]:
         bad = [k for k, m in report["metrics"].items()
                if m["regressed"] or m.get("data_s_regressed")
-               or m.get("serving_regressed")]
+               or m.get("serving_regressed") or m.get("fleet_regressed")]
         print(f"bench_track: REGRESSION in {bad}", file=sys.stderr)
         return 1
     return 0

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Render a stitched fleet (tpu_dist.sim.fleet.FleetLedger) from the CLI.
+
+    python tools/fleet_report.py /tmp/fleet           # fleet summary
+    python tools/fleet_report.py /tmp/fleet --json    # machine-readable
+
+``PATH`` is a fleet directory (the tpu_dist.sim.runner layout:
+``host<N>/run.jsonl`` families + the runner's ``fleet.jsonl``); any tree
+of per-host supervised runs with that shape works — the simulator is one
+producer, not the only one. Renders: the scenario identity, the fleet
+goodput partition (per-host goodput/badput aggregated over every attempt
+and restart gap, with the sum-check that proves categories + goodput
+account for ~100% of the aggregate wall), the restart-class histogram
+and per-host class lists (`classify_attempt` in report mode), the
+fleet-wide SLO-breach count, the cross-host elasticity timeline (every
+``scale`` event on the fleet clock), per-tenant request percentiles, and
+the hosts-live timeline from the runner's periodic ``fleet`` events.
+
+``--json`` prints :meth:`FleetLedger.report` verbatim — the stable input
+the CI acceptance (tests/test_fleet.py) asserts into. Per-host detail
+beyond this summary is one ``tools/ledger_report.py host<N>/run.jsonl``
+away (same records, same loader). Stdlib + the jax-free sim/obs modules —
+safe on a login host.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_dist.sim.fleet import FleetLedger  # noqa: E402
+
+GOODPUT_LABELS = {"startup": "startup/compile", "data_wait": "data wait",
+                  "dispatch": "dispatch", "eval": "eval",
+                  "ckpt": "checkpoint", "stall": "watchdog stall",
+                  "skipped": "health-skipped", "idle": "idle/drain",
+                  "restart_gap": "restart gap"}
+
+
+def render(report: dict, out=print) -> None:
+    sc = report.get("scenario")
+    if sc:
+        out(f"scenario: {sc.get('name')!r} seed={sc.get('seed')} "
+            f"hosts={sc.get('hosts')} ticks={sc.get('ticks')} "
+            f"tick_s={sc.get('tick_s')}")
+    hosts = report.get("hosts") or []
+    out(f"fleet: {len(hosts)} host dir(s) discovered")
+    acct = report.get("fleet")
+    if acct and not acct["aggregate_wall_s"]:
+        # every host died at (or before) its first timestamp — the wall
+        # is zero and there are no shares to print; this report exists
+        # for exactly such fleets, so say it instead of dividing by it
+        out(f"\nfleet goodput: {acct['hosts']} host(s) but ZERO aggregate "
+            "wall (no host survived past its first record)")
+    elif acct:
+        wall = acct["aggregate_wall_s"]
+        out(f"\nfleet goodput ({acct['hosts']} host(s), aggregate wall "
+            f"{wall:.1f} host-seconds):")
+        rows = [("goodput", acct["goodput_s"])] + [
+            (c, acct["categories"].get(c, 0.0)) for c in GOODPUT_LABELS]
+        for cat, secs in rows:
+            if cat != "goodput" and not secs:
+                continue
+            out(f"  {GOODPUT_LABELS.get(cat, cat):<16} {secs:9.3f}s  "
+                f"{secs / wall * 100:5.1f}%")
+        out(f"  fleet goodput ratio {acct['goodput_ratio']:.3f} over "
+            f"{acct['opt_steps']} tick(s); categories + goodput account "
+            f"for {acct['sum_check'] * 100:.1f}% of aggregate wall"
+            + (f"; OVERRUN {acct['overrun_s']:.3f}s"
+               if acct.get("overrun_s") else ""))
+        for h, hj in sorted(acct.get("per_host", {}).items()):
+            out(f"  host {h}: {hj['wall_s']:.1f}s wall, "
+                f"{hj['goodput_s']:.1f}s goodput "
+                f"(ratio {hj['ratio']}), {hj['attempts']} attempt(s)")
+    hist = report.get("restart_histogram") or {}
+    classes = report.get("restart_classes") or {}
+    if hist:
+        out(f"\nrestarts: histogram {hist}")
+        for h, cls in sorted(classes.items(), key=lambda kv: int(kv[0])):
+            out(f"  host {h}: {' -> '.join(cls) if cls else '(no attempts)'}")
+    out(f"\nSLO breaches (fleet-wide): {report.get('slo_breaches')}")
+    tenants = report.get("per_tenant") or {}
+    if tenants:
+        out("\nper-tenant serving:")
+        for name, t in tenants.items():
+            qw, tt = t["queue_wait_s"], t["ttft_s"]
+            out(f"  {name:<12} {t['requests']:4d} request(s), "
+                f"{t['tokens']} tok"
+                + (f"; queue wait p50 {qw['p50'] * 1e3:.1f}ms / "
+                   f"p99 {qw['p99'] * 1e3:.1f}ms"
+                   if qw["p50"] is not None else "")
+                + (f"; TTFT p50 {tt['p50'] * 1e3:.1f}ms / "
+                   f"p99 {tt['p99'] * 1e3:.1f}ms"
+                   if tt["p50"] is not None else ""))
+    srv = report.get("serving") or {}
+    if srv:
+        out(f"serving totals: {srv.get('completed')} completed, "
+            f"{srv.get('rejected')} rejected")
+    elas = report.get("elasticity") or []
+    if elas:
+        out(f"\nelasticity ({len(elas)} scale event(s), fleet clock):")
+        for r in elas:
+            out(f"  +{r['t_rel']:8.1f}s  host {r['host']}: "
+                f"{r.get('action')}"
+                + (f" -> {r['processes']} process(es)"
+                   if r.get("processes") is not None else "")
+                + (f" epoch {r['epoch']}" if r.get("epoch") is not None
+                   else ""))
+    live = report.get("hosts_live") or []
+    if live:
+        peak = max((r.get("hosts_live") or 0) for r in live)
+        out(f"\nhosts-live timeline: {len(live)} snapshot(s), peak {peak}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="fleet directory (host<N>/run.jsonl "
+                    "families + fleet.jsonl)")
+    ap.add_argument("--ledger-name", default="run.jsonl",
+                    help="per-host base ledger filename (default "
+                    "run.jsonl)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the FleetLedger report as one JSON object")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.path):
+        print(f"{args.path}: not a fleet directory", file=sys.stderr)
+        return 1
+    fleet = FleetLedger.discover(args.path, ledger_name=args.ledger_name)
+    if not fleet.hosts:
+        print(f"{args.path}: no host*/ dirs found", file=sys.stderr)
+        return 1
+    report = fleet.report()
+    if args.json:
+        print(json.dumps(report, default=str))
+    else:
+        render(report)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        raise SystemExit(0)

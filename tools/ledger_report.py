@@ -37,7 +37,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tpu_dist.obs.ledger import ProgressSink, phase_totals, read_ledger  # noqa: E402
+from tpu_dist.obs.ledger import ProgressSink, phase_totals  # noqa: E402
 
 
 def _mean(xs):
@@ -595,42 +595,21 @@ def main(argv=None) -> int:
                     help="read only the given file (no .aN restart-attempt "
                     "sibling stitching)")
     args = ap.parse_args(argv)
-    # restart lineage (obs.goodput): stitch every attempt of the job so
-    # the goodput section sees crash->restart gaps; any attempt's path
-    # finds the whole family
-    if args.no_discover:
-        paths = [args.path]
-    else:
-        from tpu_dist.obs.goodput import discover_attempt_paths
+    # restart lineage (obs.goodput): stitch every attempt of the job —
+    # plus the supervisor's .sup.jsonl scale-event sibling, APPENDED,
+    # never ts-interleaved — so the goodput section sees crash->restart
+    # gaps. load_job_records is THE job-loading rule (the fleet stitcher
+    # tpu_dist.sim.fleet runs it once per host); torn trailing lines and
+    # unreadable files warn instead of raising, because a crashed run is
+    # exactly the one being inspected.
+    from tpu_dist.obs.goodput import discover_attempt_paths, load_job_records
 
+    if not args.no_discover and not args.json:
         paths = discover_attempt_paths(args.path) or [args.path]
-        if len(paths) > 1 and not args.json:
+        if len(paths) > 1:
             print(f"stitching {len(paths)} attempt ledgers: "
                   f"{[os.path.basename(p) for p in paths]}")
-    # strict=False: a crashed writer leaves a torn trailing line, and a
-    # crashed run is exactly the one being inspected — warn, don't raise
-    records = []
-    for p in paths:
-        try:
-            records.extend(read_ledger(p, strict=False))
-        except OSError as e:
-            print(f"warning: skipping {p}: {e}", file=sys.stderr)
-    if not args.no_discover:
-        # the supervisor's own scale-event sibling (parallel.supervisor
-        # elasticity decisions): APPENDED to the stream, never
-        # ts-interleaved — a between-attempt scale event sorted into the
-        # middle would split a pseudo-attempt into the run_start-boundary
-        # goodput/restart math. The elasticity section orders by ts itself.
-        import re
-
-        root, ext = os.path.splitext(paths[0])
-        root = re.sub(r"\.a\d+$", "", root)  # any attempt path -> the stem
-        sup = f"{root}.sup{ext}"
-        if os.path.exists(sup):
-            try:
-                records.extend(read_ledger(sup, strict=False))
-            except OSError as e:
-                print(f"warning: skipping {sup}: {e}", file=sys.stderr)
+    records = load_job_records(args.path, discover=not args.no_discover)
     if not records:
         print(f"{args.path}: empty ledger", file=sys.stderr)
         return 1

@@ -30,7 +30,13 @@ in ``chrome://tracing`` / https://ui.perfetto.dev:
   renders its own lane group, offset on the time axis by its real
   distance from attempt 0's ``run_start``, with a ``restart gap`` slice
   spanning the crash→restart dead time the goodput report charges as
-  badput.
+  badput;
+* the **supervisor sibling** (``<stem>.sup.jsonl`` —
+  parallel.supervisor's own scale-event ledger) renders as a
+  ``supervisor`` marker lane: every ``scale`` record (shrink /
+  re-expansion / preemption snapshot / drain) as an instant event on the
+  job clock, so the elasticity timeline ``ledger_report`` prints is also
+  visible in the merged trace.
 
 Corrupt or truncated trailing lines — the signature of a crashed writer —
 are skipped with a warning (``read_ledger(strict=False)``): crashed runs
@@ -169,11 +175,16 @@ def _run_start_ts(records: list):
     return records[0]["ts"] if records else None
 
 
-def merge_job(groups: list) -> dict:
+def merge_job(groups: list, sup_records: list = ()) -> dict:
     """[(attempt_index, [lane paths]), ...] -> one Chrome trace. A single
     group is the classic multi-process merge; multiple groups (restart
     attempts, obs.goodput lineage) offset each attempt's lanes by its real
-    wall distance from attempt 0's run_start and draw the restart gap."""
+    wall distance from attempt 0's run_start and draw the restart gap.
+    ``sup_records`` (the supervisor's ``<stem>.sup.jsonl`` sibling —
+    elasticity decisions) render as their own marker lane: one instant
+    event per ``scale`` record, on the job clock, so shrink/re-expansion
+    and preemption-drain transitions sit visibly above the attempt lanes
+    instead of silently missing from the merged trace."""
     events: list = []
     lanes = 0
     multi = len(groups) > 1
@@ -235,10 +246,31 @@ def merge_job(groups: list) -> dict:
                            "args": {"gap_s": round(gap, 3),
                                     "attempt": att}})
         prev_end = att_end
+    scales = [r for r in (sup_records or ())
+              if r.get("event") == "scale" and r.get("ts") is not None]
+    if scales and job_t0 is not None:
+        # the supervisor lane: one stride past the HIGHEST attempt
+        # ordinal (lane offsets key on the filename-stamped ordinal, not
+        # list position — a lost intermediate attempt must not make this
+        # lane collide with the last attempt's)
+        sup_pid = pid_stride * (max((att for att, _ in groups),
+                                    default=0) + 1)
+        events.append({"ph": "M", "name": "process_name", "pid": sup_pid,
+                       "tid": 0, "args": {"name": "supervisor"}})
+        events.append({"ph": "M", "name": "thread_name", "pid": sup_pid,
+                       "tid": 0, "args": {"name": "scale events"}})
+        for r in scales:
+            events.append({
+                "ph": "i", "name": f"scale:{r.get('action')}",
+                "pid": sup_pid, "tid": 0,
+                "ts": max((r["ts"] - job_t0) * 1e6, 0.0), "s": "g",
+                "args": _args(r, ("action", "processes", "epoch", "hosts",
+                                  "step", "world_from", "shed"))})
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"tool": "tpu_dist tools/trace_merge.py",
                           "processes": lanes,
                           "attempts": len(groups),
+                          "scale_events": len(scales),
                           "clock": ("per-process, zeroed at attempt 0's "
                                     "run_start" if multi else
                                     "per-process, zeroed at run_start")}}
@@ -275,7 +307,19 @@ def main(argv=None) -> int:
             # label by the filename's stamped ordinal, not list position:
             # a lost intermediate attempt must not renumber the rest
             groups.append((attempt_ordinal(base), lane_paths))
-        trace = merge_job(groups)
+        # the supervisor's own scale-event sibling (parallel.supervisor
+        # elasticity decisions) renders as a marker lane — without it a
+        # merged trace of an elastic run silently omits every rescale
+        from tpu_dist.obs.goodput import sup_sibling_path
+
+        sup_path = sup_sibling_path(attempt_paths[0])
+        sup_records = []
+        if os.path.exists(sup_path):
+            try:
+                sup_records = read_ledger(sup_path, strict=False)
+            except OSError as e:
+                print(f"warning: skipping {sup_path}: {e}", file=sys.stderr)
+        trace = merge_job(groups, sup_records=sup_records)
     if not trace["traceEvents"]:
         print("no records in any input ledger", file=sys.stderr)
         return 1
@@ -283,8 +327,10 @@ def main(argv=None) -> int:
     with open(out, "w") as f:
         json.dump(trace, f)
     n_att = trace["otherData"].get("attempts", 1)
+    n_scale = trace["otherData"].get("scale_events", 0)
     print(f"{out}: {trace['otherData']['processes']} process lane(s)"
           + (f" across {n_att} attempts" if n_att > 1 else "")
+          + (f", {n_scale} supervisor scale event(s)" if n_scale else "")
           + f", {len(trace['traceEvents'])} events — load in "
           "chrome://tracing or ui.perfetto.dev")
     return 0

@@ -66,11 +66,15 @@ from tpu_dist.engine.kv_cache import PagedKVPool
 class DecodeRequest:
     """One generation request: continue ``prompt`` by ``max_new_tokens``
     (or until ``ServeConfig.eos_id``). ``rid`` is the caller's correlation
-    id — it rides every ledger event this request produces."""
+    id — it rides every ledger event this request produces — and
+    ``tenant`` (optional) names the traffic class, so multi-tenant
+    deployments get per-tenant SLO accounting from the same ``request``
+    events (tools/fleet_report.py renders the percentiles)."""
 
     rid: int
     prompt: np.ndarray
     max_new_tokens: int
+    tenant: Optional[str] = None
 
 
 @dataclass
@@ -310,7 +314,8 @@ class ServeEngine:
         self.ledger.emit("admit", rid=req.rid, accepted=accepted,
                          queue_depth=len(self.queue),
                          pages_free=self.pool.pages_free,
-                         reason=reason, ts_engine=round(now, 6))
+                         reason=reason, tenant=req.tenant,
+                         ts_engine=round(now, 6))
 
     def _observe_wait(self, wait: float) -> None:
         a = self.cfg.slo_alpha
@@ -407,15 +412,18 @@ class ServeEngine:
 
         return uninstall
 
-    def drain(self, reason: str = "sigterm",
-              max_ticks: int = 100_000) -> List[Completion]:
+    def drain(self, reason: str = "sigterm", max_ticks: int = 100_000,
+              emit_run_end: bool = True) -> List[Completion]:
         """Graceful shutdown: finish every IN-FLIGHT sequence (they hold
         pages and partial generations — killing them wastes the work),
         reject the whole queue with a ``shed`` admission record (the
         caller's signal to retry elsewhere), free all pages via the normal
         eviction path, and emit ``run_end`` so the ledger shows a drained
         server, not a mid-tick corpse. Idempotent; returns the completions
-        of the in-flight sequences."""
+        of the in-flight sequences. ``emit_run_end=False`` leaves the
+        final ``run_end`` to a caller that owns run lifecycle already
+        (the fleet-sim worker's RunObs stamps its own status/lineage —
+        two run_end records in one attempt would corrupt classification)."""
         if self._drained:
             return []
         self.draining = True
@@ -438,12 +446,13 @@ class ServeEngine:
             self.ledger.emit(
                 "scale", action="drain", processes=1, epoch=None,
                 reason=reason, shed=len(shed), finished=len(out))
-            self.ledger.emit(
-                "run_end", steps=self.ticks,
-                seconds=round(self._now() - self._t_start, 6),
-                status="preempted", reason=reason,
-                completed=self.completed, rejected=self.rejected,
-                shed=len(shed))
+            if emit_run_end:
+                self.ledger.emit(
+                    "run_end", steps=self.ticks,
+                    seconds=round(self._now() - self._t_start, 6),
+                    status="preempted", reason=reason,
+                    completed=self.completed, rejected=self.rejected,
+                    shed=len(shed))
         return out
 
     # -- internals --------------------------------------------------------
@@ -471,6 +480,7 @@ class ServeEngine:
                     first_token_ts=round(comp.first_token_ts, 6),
                     finish_ts=round(comp.finish_ts, 6),
                     prompt_len=comp.prompt_len,
+                    tenant=slot.req.tenant,
                     ttft_s=round(comp.ttft_s, 6))
         return out
 

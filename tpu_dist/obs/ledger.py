@@ -119,6 +119,18 @@ EVENT_SCHEMA = {
     # consensus is configured); hosts/step/world_from ride as extras.
     # ledger_report stitches these into the elasticity timeline
     "scale": ("action", "processes", "epoch"),
+    # fleet-simulation identity (tpu_dist.sim.runner): the scenario one
+    # fleet run executed — name/seed/hosts/ticks pin the deterministic
+    # schedule so a fleet report is self-describing; tick_s/events ride
+    # as extras. One per fleet ledger, the fleet analog of run_start
+    "scenario": ("name", "seed", "hosts", "ticks"),
+    # fleet-plane rollup (tpu_dist.sim.runner, periodic + final=True):
+    # hosts_live is the count of virtual hosts with a running child,
+    # goodput_ratio the stitched fleet ratio (None on periodic snapshots
+    # — the full stitch runs once at the end), slo_breaches the
+    # cumulative fleet-wide breach count. Feeds the
+    # tpu_dist_fleet_* Prometheus series through the metrics sink
+    "fleet": ("hosts_live", "goodput_ratio", "slo_breaches"),
     # run rollup: total steps, wall seconds, best metric in extras;
     # status ("ok"|"crashed"|"interrupted") rides as an extra stamped by
     # RunObs.run_end — the crash-safe shutdown path sets "crashed"

@@ -385,13 +385,31 @@ def metrics_ledger_sink(reg: MetricsRegistry):
     degraded_g = reg.gauge("tpu_dist_degraded",
                            "1 while running on a shrunken (degraded) "
                            "mesh, 0 at the planned world size")
+    # fleet plane (tpu_dist.sim `fleet` events): the stitched goodput
+    # ratio, live-host count and cumulative SLO-breach total of a whole
+    # simulated (or real multi-supervisor) fleet — the dashboard view of
+    # "handles heavy traffic" as one number per scrape
+    fleet_ratio = reg.gauge("tpu_dist_fleet_goodput_ratio",
+                            "stitched fleet goodput share of aggregate "
+                            "wall (0-1), from the last fleet event")
+    fleet_hosts = reg.gauge("tpu_dist_fleet_hosts_live",
+                            "virtual hosts with a running child, from "
+                            "the last fleet event")
+    fleet_breaches = reg.counter("tpu_dist_fleet_slo_breaches_total",
+                                 "fleet-wide SLO breaches (monotonic; "
+                                 "fed by deltas of the fleet events' "
+                                 "cumulative count)")
+    # fleet events carry the CUMULATIVE count; a Prometheus counter must
+    # only move forward, so the sink feeds it deltas
+    fleet_breach_seen = [0.0]
     # materialize the unlabeled children too — a family with no child
     # renders no sample line, and "0" vs "absent" are different answers
     # to "is it hung?"
     for m in (steps, items, mfu, loss, stalls, stall_idle, skew_spread,
               straggler, epoch_g, eval_loss, hbm, decode_toks, step_hist,
               goodput_ratio, serve_queue, serve_active, kv_free, serve_reqs,
-              serve_rejects, serve_toks, mesh_procs, degraded_g):
+              serve_rejects, serve_toks, mesh_procs, degraded_g,
+              fleet_ratio, fleet_hosts, fleet_breaches):
         m.labels()
 
     def sink(rec: dict) -> None:
@@ -491,6 +509,15 @@ def metrics_ledger_sink(reg: MetricsRegistry):
                 degraded_g.set(1.0)
             elif act == "expand":
                 degraded_g.set(0.0)
+        elif ev == "fleet":
+            if rec.get("hosts_live") is not None:
+                fleet_hosts.set(rec["hosts_live"])
+            if rec.get("goodput_ratio") is not None:
+                fleet_ratio.set(rec["goodput_ratio"])
+            v = rec.get("slo_breaches")
+            if v is not None and v > fleet_breach_seen[0]:
+                fleet_breaches.inc(v - fleet_breach_seen[0])
+                fleet_breach_seen[0] = v
 
     return sink
 

@@ -263,7 +263,7 @@ class AttemptResult:
 
 @dataclass
 class SupervisorResult:
-    status: str  # clean | crash_loop | restarts_exhausted
+    status: str  # clean | crash_loop | restarts_exhausted | stopped
     attempts: List[AttemptResult] = field(default_factory=list)
 
     @property
@@ -354,7 +354,9 @@ class Supervisor:
                  forward_flags: bool = True, poll_s: float = 0.25,
                  sleep: Callable[[float], None] = time.sleep,
                  consensus: Optional[ConsensusDir] = None,
-                 consensus_poll_s: float = 1.0):
+                 consensus_poll_s: float = 1.0,
+                 on_attempt: Optional[Callable[["AttemptResult"],
+                                               None]] = None):
         if not cmd:
             raise ValueError("supervisor needs a training command "
                              "(everything after '--')")
@@ -384,6 +386,19 @@ class Supervisor:
         self._scale_relaunch = False            # WE ended the attempt to
         self._peer_resume_next = False          # rescale, not a failure
         self._scale_ledger = None
+        # scenario hooks (round 14, tpu_dist.sim): a fleet driver observes
+        # every classified attempt and can end the policy loop externally
+        self.on_attempt = on_attempt
+        self._stop = threading.Event()
+
+    def request_stop(self) -> None:
+        """Ask the policy loop to end (thread-safe, callable from any
+        thread): a running child is terminated gracefully — SIGTERM with
+        the preemption deadline, so a snapshot-capable child drains and
+        exits ``PREEMPT_SNAPSHOT_RC`` — and no further restarts happen
+        (``SupervisorResult.status == "stopped"``). The fleet simulator's
+        scenario-end teardown; also the backstop for a wedged run."""
+        self._stop.set()
 
     def _log(self, msg: str) -> None:
         print(f"[supervise] {msg}", file=sys.stderr, flush=True)
@@ -394,11 +409,11 @@ class Supervisor:
         (obs.ledger is stdlib-only, so this stays jax-free);
         ledger_report merges it into the job timeline."""
         if self._scale_ledger is None:
+            from tpu_dist.obs.goodput import sup_sibling_path
             from tpu_dist.obs.ledger import Ledger
 
-            root, ext = os.path.splitext(self.ledger)
             try:
-                self._scale_ledger = Ledger(f"{root}.sup{ext}")
+                self._scale_ledger = Ledger(sup_sibling_path(self.ledger))
             except OSError as e:
                 self._log(f"warning: no scale ledger ({e})")
                 self._scale_ledger = False
@@ -491,6 +506,16 @@ class Supervisor:
             while proc.poll() is None:
                 self._sleep(self.poll_s)
                 now = time.monotonic()
+                if self._stop.is_set():
+                    # external teardown (request_stop): same graceful
+                    # SIGTERM-with-deadline path as a rescale — a
+                    # snapshot-capable child drains and accounts for
+                    # itself before the SIGKILL backstop
+                    self._log("stop requested — SIGTERM, graceful "
+                              "deadline, then teardown")
+                    scale_term = True
+                    proc.terminate()
+                    break
                 if (self.consensus is not None
                         and now - last_consensus >= self.consensus_poll_s):
                     # heartbeat our membership + watch for an epoch bump
@@ -604,6 +629,10 @@ class Supervisor:
         consecutive_dead = 0
         restarts = 0
         while True:
+            if self._stop.is_set():
+                # a stop that lands during backoff must not launch one
+                # more child just to tear it down again
+                return SupervisorResult("stopped", attempts)
             # two counters on purpose: the LEDGER ordinal only advances
             # when a child lived long enough to create its attempt file (a
             # pre-RunObs death must not burn a lineage slot), while the
@@ -647,6 +676,14 @@ class Supervisor:
             attempts.append(result)
             self._log(f"attempt {attempt_no} ended: rc={rc} class={cls} "
                       f"({steps} step record(s) in {result.seconds:.1f}s)")
+            if self.on_attempt is not None:
+                try:
+                    self.on_attempt(result)
+                except Exception as e:  # an observer must never kill policy
+                    self._log(f"warning: on_attempt hook failed ({e})")
+            if self._stop.is_set():
+                return SupervisorResult(
+                    "clean" if cls == "clean" else "stopped", attempts)
             if self._scale_relaunch:
                 # WE ended this attempt to re-form the mesh at a new
                 # epoch: not a failure — no restart budget, no backoff,

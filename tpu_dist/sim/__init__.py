@@ -1,0 +1,71 @@
+"""tpu_dist.sim — trace-driven fleet simulation on one CPU box.
+
+The ROADMAP north star claims "heavy traffic from millions of users"; this
+package is what turns that claim into a regression-gated number. Every
+piece it composes already exists one-process-at-a-time — deterministic
+fault injection (:mod:`tpu_dist.obs.faults`), goodput/SLO accounting
+(:mod:`tpu_dist.obs.goodput`), the serve-trace replay
+(:mod:`tpu_dist.engine.serve`), the elastic supervisor + consensus
+(:mod:`tpu_dist.parallel.supervisor`) — and the simulator runs them
+*together*: N supervised serve-engine processes on virtual CPU devices
+under one declarative **scenario schedule** (diurnal Poisson traffic,
+preemption waves, slow-host skew, host returns), each writing its normal
+attempt ledger plus the supervisor ``.sup.jsonl`` sibling.
+
+Modules (attribute access is lazy, PEP 562, for the same reason as
+:mod:`tpu_dist.parallel`: the scenario grammar and the fleet stitcher must
+import on a login/CI host with no jax installed):
+
+* :mod:`~tpu_dist.sim.scenario` — the schedule grammar + deterministic
+  compiler (stdlib-only; same schedule + seed -> identical admitted
+  requests and injected faults);
+* :mod:`~tpu_dist.sim.fleet` — the :class:`FleetLedger` stitcher: cross-
+  host discovery (the fleet analog of ``ledger_report``'s attempt
+  discovery), clock normalization, and the fleet accounting rollup
+  (stdlib-only);
+* :mod:`~tpu_dist.sim.runner` — :class:`FleetSim`, the driver that
+  launches one :class:`~tpu_dist.parallel.supervisor.Supervisor` per
+  virtual host and executes the scenario's consensus actions (jax-free
+  itself; only the worker children import jax);
+* :mod:`~tpu_dist.sim.worker` — the child process entry
+  (``python -m tpu_dist.sim.worker``): a tiny TransformerLM behind a
+  :class:`~tpu_dist.engine.serve.ServeEngine`, replaying its host's
+  arrival slice in paced tick time.
+
+``tools/fleet_report.py`` renders the stitched fleet (goodput summing to
+aggregate wall, restart-class histogram, SLO breaches, elasticity
+timeline, per-tenant percentiles); ``tests/test_fleet.py`` pins the CI
+acceptance scenario in ``scripts/fleet_ci.json``.
+"""
+
+import importlib
+
+_LAZY = {
+    "scenario": None,
+    "fleet": None,
+    "runner": None,
+    "worker": None,
+    # scenario grammar
+    "Scenario": "scenario", "HostPlan": "scenario", "Arrival": "scenario",
+    "load_scenario": "scenario",
+    # fleet stitcher
+    "FleetLedger": "fleet",
+    # driver
+    "FleetSim": "runner",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if name not in _LAZY:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    if target is None:
+        return importlib.import_module(f"{__name__}.{name}")
+    module = importlib.import_module(f"{__name__}.{target}")
+    return getattr(module, name)
+
+
+def __dir__():
+    return __all__

@@ -1,0 +1,261 @@
+"""FleetSim: drive one scenario across N supervised virtual hosts.
+
+The driver is jax-free by the same construction as the supervisor it
+composes (only the worker children import jax): per host it runs one
+:class:`~tpu_dist.parallel.supervisor.Supervisor` (in a thread) around
+``python -m tpu_dist.sim.worker``, exports that host's compiled fault
+spec as ``TPU_DIST_FAULTS``, and gives the scenario's ``consensus_host``
+a real :class:`~tpu_dist.parallel.consensus.ConsensusDir` — so a
+preemption wave's ``leave`` and the later ``register`` (the host return)
+drive the PR 12 membership path for real: epoch bump, mid-attempt
+SIGTERM, rescale relaunch, ``shrink``/``expand`` scale events in the
+``.sup.jsonl`` sibling.
+
+Scheduling is on the **fleet clock**: consensus actions fire when every
+live (not scheduled-down, not finished) host's published tick
+(``<ledger>.tick`` sidecar) has reached the action's tick — tick gating
+both orders the actions deterministically w.r.t. the traffic and proves
+the gated hosts are actually serving (a host mid-restart holds the clock
+until it resumes). A wall deadline backstops a wedged fleet.
+
+Outputs under ``out_dir``::
+
+    scenario.json   # the normalized schedule (self-contained artifact)
+    fleet.jsonl     # the runner's own ledger: scenario + fleet events
+    host<N>/        # each host's attempt ledgers + .sup sibling + sidecars
+    report.json     # the stitched FleetLedger report
+    headline.json   # bench_track-shaped point carrying fleet.goodput_ratio
+
+``python -m tpu_dist.sim.runner --scenario scripts/fleet_ci.json --out
+/tmp/fleet`` is the CLI; ``tools/fleet_report.py`` renders the result.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpu_dist.obs.ledger import Ledger
+from tpu_dist.obs.metrics import MetricsRegistry, metrics_ledger_sink
+from tpu_dist.parallel.consensus import ConsensusDir
+from tpu_dist.parallel.supervisor import RestartPolicy, Supervisor
+from tpu_dist.sim.scenario import (Scenario, compile_host_plans,
+                                   load_scenario)
+
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def _scrubbed_env(extra: Dict[str, str]) -> Dict[str, str]:
+    """A child env with no inherited TPU_DIST/XLA state (the test
+    harness's own knobs must not leak into the simulated hosts).
+    ``python -m tpu_dist.sim.worker`` must resolve from any cwd, so the
+    package root rides PYTHONPATH."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("TPU_DIST") and k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO_ROOT + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env.update(extra)
+    return env
+
+
+class FleetSim:
+    """One scenario run (see module docstring). ``scenario`` may be a
+    path or a parsed :class:`~tpu_dist.sim.scenario.Scenario`."""
+
+    def __init__(self, scenario, out_dir: str, *,
+                 python: str = sys.executable,
+                 stall_timeout_s: float = 300.0,
+                 max_restarts: int = 6):
+        self.sc: Scenario = (scenario if isinstance(scenario, Scenario)
+                             else load_scenario(scenario))
+        self.out = out_dir
+        self.python = python
+        self.stall_timeout_s = stall_timeout_s
+        self.max_restarts = max_restarts
+        self.plans, self.actions = compile_host_plans(self.sc)
+        self.results: Dict[int, object] = {}
+        self._sups: Dict[int, Supervisor] = {}
+        self._breaches = 0
+
+    # -- wiring -----------------------------------------------------------
+    def _host_dir(self, h: int) -> str:
+        return os.path.join(self.out, f"host{h}")
+
+    def _ledger_path(self, h: int) -> str:
+        return os.path.join(self._host_dir(h), "run.jsonl")
+
+    def _build_supervisor(self, h: int, cdir: str,
+                          scenario_path: str) -> Supervisor:
+        plan = self.plans[h]
+        sc = self.sc
+        env = _scrubbed_env({
+            "TPU_DIST_NUM_PROCESSES": str(sc.hosts),
+            "TPU_DIST_PROCESS_ID": str(h),
+            **({"TPU_DIST_FAULTS": plan.faults} if plan.faults else {}),
+        })
+        # a preempted-with-return host must stay genuinely absent until
+        # its return tick: the first restart's backoff covers the gap
+        holdoff = plan.restart_holdoff_ticks * sc.tick_s * plan.skew
+        policy = RestartPolicy(
+            max_restarts=self.max_restarts,
+            backoff_base_s=max(holdoff, 0.2),
+            backoff_max_s=max(holdoff * 2, 30.0),
+            stall_timeout_s=self.stall_timeout_s,
+            # the sim's SIGTERM faults are the schedule, not host loss
+            shrink_on_host_loss=False)
+        consensus = (ConsensusDir(cdir, h, planned=sc.hosts, lease_s=3600.0)
+                     if h == sc.consensus_host else None)
+        return Supervisor(
+            [self.python, "-m", "tpu_dist.sim.worker",
+             "--scenario", scenario_path, "--host", str(h)],
+            ledger=self._ledger_path(h), policy=policy, env=env,
+            poll_s=0.1, consensus=consensus, consensus_poll_s=0.25)
+
+    def _read_tick(self, h: int) -> int:
+        try:
+            with open(self._ledger_path(h) + ".tick") as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    # -- the run ----------------------------------------------------------
+    def run(self, timeout_s: Optional[float] = None) -> dict:
+        sc = self.sc
+        os.makedirs(self.out, exist_ok=True)
+        for h in range(sc.hosts):
+            os.makedirs(self._host_dir(h), exist_ok=True)
+        scenario_path = os.path.join(self.out, "scenario.json")
+        with open(scenario_path, "w") as f:
+            json.dump(sc.to_doc(), f, indent=1)
+        if timeout_s is None:
+            # paced trace + a compile/restart allowance per expected launch
+            launches = sc.hosts + sum(
+                len(p.expected_classes) - 1 for p in self.plans.values())
+            timeout_s = sc.wall_estimate_s() * 4 + 90.0 * launches + 120.0
+
+        fleet_ledger = Ledger(os.path.join(self.out, "fleet.jsonl"))
+        registry = MetricsRegistry()
+        fleet_ledger.add_sink(metrics_ledger_sink(registry))
+        fleet_ledger.emit("scenario", name=sc.name, seed=sc.seed,
+                          hosts=sc.hosts, ticks=sc.ticks,
+                          tick_s=sc.tick_s, consensus_host=sc.consensus_host,
+                          events=[dict(ev) for ev in sc.events])
+
+        cdir = os.path.join(self.out, "consensus")
+        peers = {h: ConsensusDir(cdir, h, planned=sc.hosts, lease_s=3600.0)
+                 for h in range(sc.hosts)}
+        for c in peers.values():
+            c.register()
+
+        threads: Dict[int, threading.Thread] = {}
+        for h in range(sc.hosts):
+            sup = self._build_supervisor(h, cdir, scenario_path)
+            self._sups[h] = sup
+
+            def _run(h=h, sup=sup):
+                self.results[h] = sup.run()
+
+            t = threading.Thread(target=_run, name=f"fleet-sup-{h}",
+                                 daemon=True)
+            threads[h] = t
+            t.start()
+
+        pending = list(self.actions)
+        down: set = set()
+        t_start = time.monotonic()
+        force_after = t_start + timeout_s * 0.75
+        last_fleet_emit = 0.0
+        while any(t.is_alive() for t in threads.values()):
+            now = time.monotonic()
+            if now - t_start > timeout_s:
+                for sup in self._sups.values():
+                    sup.request_stop()
+                break
+            # fleet clock: every live gated host must have reached the tick
+            live = [h for h, t in threads.items()
+                    if t.is_alive() and h not in down]
+            clock = min((self._read_tick(h) for h in live), default=None)
+            while pending and ((clock is not None
+                                and clock >= pending[0].tick)
+                               or now > force_after or not live):
+                act = pending.pop(0)
+                if act.action == "leave":
+                    peers[act.host].leave()
+                    down.add(act.host)
+                elif act.action == "register":
+                    peers[act.host].register()
+                    down.discard(act.host)
+            if now - last_fleet_emit >= 1.0:
+                last_fleet_emit = now
+                fleet_ledger.emit("fleet", hosts_live=len(live),
+                                  goodput_ratio=None, slo_breaches=None,
+                                  final=False)
+            time.sleep(0.1)
+        for t in threads.values():
+            t.join(timeout=max(timeout_s * 0.25, 30.0))
+
+        from tpu_dist.sim.fleet import FleetLedger
+
+        stitched = FleetLedger.discover(self.out)
+        report = stitched.report()
+        report["supervisors"] = {
+            str(h): {"status": getattr(r, "status", "unjoined"),
+                     "attempts": [a.failure_class
+                                  for a in getattr(r, "attempts", ())]}
+            for h, r in sorted(self.results.items())}
+        acct = report.get("fleet") or {}
+        fleet_ledger.emit("fleet", hosts_live=0,
+                          goodput_ratio=acct.get("goodput_ratio"),
+                          slo_breaches=report.get("slo_breaches"),
+                          final=True)
+        fleet_ledger.close()
+        with open(os.path.join(self.out, "report.json"), "w") as f:
+            json.dump(report, f, indent=1, default=str)
+        # the bench_track-shaped point: fleet.goodput_ratio is the gated
+        # number (tools/bench_track.py abstains on pre-fleet history)
+        with open(os.path.join(self.out, "headline.json"), "w") as f:
+            json.dump({"metric": "fleet_sim_goodput",
+                       "value": acct.get("goodput_ratio"),
+                       "unit": "ratio",
+                       "fleet": {"goodput_ratio": acct.get("goodput_ratio"),
+                                 "slo_breaches": report.get("slo_breaches"),
+                                 "hosts": sc.hosts}}, f, indent=1)
+        return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", required=True,
+                    help="scenario JSON/YAML (tpu_dist.sim.scenario)")
+    ap.add_argument("--out", required=True, help="fleet output directory")
+    ap.add_argument("--timeout-s", type=float, default=0.0,
+                    help="wall bound for the whole fleet (0 = derived "
+                    "from the schedule)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the fleet report JSON on stdout")
+    args = ap.parse_args(argv)
+    sim = FleetSim(args.scenario, args.out)
+    report = sim.run(timeout_s=args.timeout_s or None)
+    if args.json:
+        print(json.dumps(report, default=str))
+    else:
+        acct = report.get("fleet") or {}
+        print(f"fleet '{(report.get('scenario') or {}).get('name')}': "
+              f"{len(report['hosts'])} host(s), goodput ratio "
+              f"{acct.get('goodput_ratio')}, "
+              f"{report.get('slo_breaches')} SLO breach(es), "
+              f"restart histogram {report.get('restart_histogram')} — "
+              f"full report: {os.path.join(args.out, 'report.json')}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,278 @@
+"""Fleet-sim worker: one virtual host = one supervised serve process.
+
+``python -m tpu_dist.sim.worker --scenario s.json --host 2`` replays host
+2's slice of the compiled scenario through a real
+:class:`~tpu_dist.engine.serve.ServeEngine` over a tiny
+:func:`~tpu_dist.models.transformer.tiny_lm`, on virtual CPU devices (the
+conftest trick, applied before jax initializes). Everything it emits is
+the NORMAL per-run observability surface — ``run_start`` / ``compile`` /
+windowed ``step`` records / ``admit`` / ``request`` / ``kv_cache`` /
+``slo`` / ``goodput`` / ``run_end`` through :class:`~tpu_dist.obs.RunObs`
+— so the fleet stitcher aggregates ordinary ledgers, not a bespoke sim
+format, and every fleet rollup (goodput, SLO breaches, restart classes)
+is computed by the SAME code that serves single-host runs.
+
+Time is paced in scenario ticks (``tick_s`` per tick, stretched by the
+host's slow-host ``skew`` factor): arrivals are submitted when the global
+tick reaches their scheduled tick, so the admitted schedule is
+machine-speed-independent — a slow box makes ticks late, never different.
+The global tick survives restarts through a cursor sidecar
+(``<ledger>.cursor.json``: resume tick + completed rids), so a preempted
+host resumes where the fleet clock left it instead of replaying from
+zero; a ``<ledger>.tick`` sidecar publishes the current tick for the
+runner's fleet-clock gate.
+
+Faults ride the standard machinery: the supervisor exports
+``TPU_DIST_FAULTS`` from the scenario compile, and the tick loop checks
+:func:`~tpu_dist.obs.faults.fire_step` once per tick — ``hard_exit``
+kills, ``hang`` wedges, ``preempt_sigterm`` lands on the RunObs
+coordinated-preemption handler, which this loop honors by draining the
+serve engine (finish in-flight, shed the queue, free pages), stamping
+``run_end status=preempted`` and exiting ``PREEMPT_SNAPSHOT_RC`` so the
+supervisor classifies ``preemption_snapshotted``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+
+# ticks per step-record window (and per cursor/tick-file refresh)
+WINDOW_TICKS = 8
+
+
+@dataclass
+class SimWorkerConfig:
+    """The RunObs-facing config (run_start stamps it whole)."""
+
+    ledger_path: str = ""
+    attempt: int = 0
+    job_id: str = ""
+    scenario: str = ""
+    host: int = 0
+    skew: float = 1.0
+    tick_s: float = 0.02
+    resume: str = ""
+    watchdog_factor: float = 0.0     # serve ticks are ms-scale; the
+    skew_every: int = 0              # supervisor's ledger tail is liveness
+    health: str = "record"
+    goodput_every_s: float = 0.0     # final partition only
+    metrics_port: int = 0
+    faults: str = ""
+    serve: dict = field(default_factory=dict)
+    model: dict = field(default_factory=dict)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        description="fleet-sim serve worker (one virtual host)")
+    ap.add_argument("--scenario", required=True,
+                    help="scenario JSON/YAML (tpu_dist.sim.scenario)")
+    ap.add_argument("--host", type=int, required=True)
+    ap.add_argument("--ledger-path", default="",
+                    help="base attempt-ledger path (the supervisor "
+                    "forwards this)")
+    ap.add_argument("--attempt", type=int, default=0,
+                    help="-1 = auto next free index (supervisor lineage)")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="virtual CPU device count (0 = scenario's "
+                    "worker_devices)")
+    ap.add_argument("--metrics-port", type=int, default=0)
+    # tolerated supervisor forwardings (serving has no checkpoint/mesh)
+    ap.add_argument("--checkpoint-dir", default="")
+    ap.add_argument("--resume", default="")
+    ap.add_argument("--mesh-shape", default="")
+    ap.add_argument("--mesh-axes", default="")
+    return ap
+
+
+def _cursor_path(base: str) -> str:
+    return base + ".cursor.json"
+
+
+def _read_cursor(base: str):
+    try:
+        with open(_cursor_path(base)) as f:
+            doc = json.load(f)
+        return int(doc.get("tick", 0)), set(doc.get("done", []))
+    except (OSError, ValueError):
+        return 0, set()
+
+
+def _write_cursor(base: str, tick: int, done) -> None:
+    tmp = _cursor_path(base) + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"tick": tick, "done": sorted(done)}, f)
+        os.replace(tmp, _cursor_path(base))
+    except OSError:
+        pass  # progress bookkeeping must never kill the host
+
+
+def _write_tick(base: str, tick: int) -> None:
+    try:
+        with open(base + ".tick", "w") as f:
+            f.write(f"{tick}\n")
+    except OSError:
+        pass
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    # virtual devices BEFORE jax initializes (the conftest 8-device trick)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tpu_dist._compat import set_cpu_device_count
+    from tpu_dist.sim.scenario import compile_host_plans, load_scenario
+
+    sc = load_scenario(args.scenario)
+    devices = args.devices or sc.worker_devices
+    try:
+        set_cpu_device_count(max(devices, 1))
+    except RuntimeError:
+        pass  # backend already initialized (in-process test harness)
+
+    plans, _actions = compile_host_plans(sc)
+    if args.host not in plans:
+        raise SystemExit(f"host {args.host} not in scenario "
+                         f"(hosts: {sc.hosts})")
+    plan = plans[args.host]
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist.engine.serve import DecodeRequest, ServeConfig, ServeEngine
+    from tpu_dist.models.transformer import tiny_lm
+    from tpu_dist.obs import RunObs
+    from tpu_dist.parallel.supervisor import PREEMPT_SNAPSHOT_RC
+
+    model_kw = {"vocab_size": 64, "num_layers": 1, "d_model": 32,
+                "num_heads": 2, "max_len": 64, **sc.model}
+    serve_kw = {"max_slots": 2, "page_size": 8, "num_pages": 64,
+                "kv_event_every": 32, **sc.serve}
+    cfg = SimWorkerConfig(
+        ledger_path=args.ledger_path, attempt=args.attempt,
+        job_id=f"{sc.name}-h{args.host}", scenario=sc.name,
+        host=args.host, skew=plan.skew, tick_s=sc.tick_s,
+        metrics_port=args.metrics_port, serve=serve_kw, model=model_kw)
+
+    obs = RunObs("fleet_sim", cfg, unit="tok/s")
+    obs.enable_preempt_snapshot()   # SIGTERM = drain request, not death
+    obs.run_start()
+
+    base = args.ledger_path or ""
+    start_tick, done = _read_cursor(base) if base else (0, set())
+    arrivals = [a for a in plan.arrivals if a.rid not in done]
+
+    lm = tiny_lm(**model_kw)
+    params = lm.init({"params": jax.random.PRNGKey(sc.seed)},
+                     jnp.zeros((1, model_kw["max_len"]), jnp.int32),
+                     train=False)["params"]
+    eng = ServeEngine(lm, params, ServeConfig(**serve_kw),
+                      ledger=obs.ledger)
+    arrival_rng = np.random.default_rng(sc.seed * 7919 + args.host)
+
+    def _prompt(a):
+        # content is irrelevant to the schedule; lengths are the load
+        return arrival_rng.integers(1, model_kw["vocab_size"],
+                                    a.prompt_len).astype(np.int32)
+
+    def _drain_and_exit(reason: str, tick: int) -> int:
+        comps = eng.drain(reason=reason, emit_run_end=False)
+        for c in comps:
+            done.add(c.rid)
+        if base:
+            _write_cursor(base, tick, done)
+            _write_tick(base, tick)
+        obs.run_end(status="preempted", snapshot_tick=tick,
+                    completed=eng.completed, rejected=eng.rejected)
+        return PREEMPT_SNAPSHOT_RC
+
+    tick = start_tick
+    i = 0
+    window_t0 = time.perf_counter()
+    window_device_s = 0.0
+    window_dispatch_s = 0.0
+    window_tokens = 0
+    window_start_tick = tick
+    emitted_compile = False
+    t_run0 = time.perf_counter()
+    status_extra = {}
+    try:
+        while (tick < sc.ticks or i < len(arrivals) or eng.queue
+               or any(s is not None for s in eng.slots)):
+            if tick > sc.ticks * 10 + 100_000:
+                raise RuntimeError(f"worker did not drain by tick {tick}")
+            # coordinated preemption (SIGTERM via RunObs, or an injected
+            # preempt_deadline advance notice below)
+            if obs.preempt_pending():
+                return _drain_and_exit(obs.preempt_source or "sigterm",
+                                       tick)
+            effects = obs.fire_step_faults(tick)
+            if "preempt_deadline" in effects:
+                return _drain_and_exit("preempt_deadline", tick)
+            t0 = time.perf_counter()
+            while i < len(arrivals) and arrivals[i].tick <= tick:
+                a = arrivals[i]
+                eng.submit(DecodeRequest(a.rid, _prompt(a), a.out_len,
+                                         tenant=a.tenant))
+                i += 1
+            window_dispatch_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            comps = eng.step()
+            window_device_s += time.perf_counter() - t0
+            for c in comps:
+                done.add(c.rid)
+                window_tokens += c.n_generated
+            tick += 1
+            # pacing: the global tick maps to wall time at tick_s x skew;
+            # a slow machine just runs late (schedules never change)
+            target = window_t0 + (tick - window_start_tick) \
+                * sc.tick_s * plan.skew
+            sleep = target - time.perf_counter()
+            if sleep > 0:
+                time.sleep(sleep)
+            if tick % WINDOW_TICKS == 0 or tick >= sc.ticks:
+                now = time.perf_counter()
+                warm = not emitted_compile
+                if warm:
+                    # engines emit 'compile' right after the warm
+                    # dispatch; the run_start->compile gap is the startup
+                    # badput and the warm record below stays uncharged
+                    obs.ledger.emit("compile", program="serve_tick",
+                                    seconds=round(now - t_run0, 3))
+                    emitted_compile = True
+                obs.step(step=tick, loss=None, n_items=window_tokens,
+                         wall_s=now - window_t0, data_s=0.0,
+                         dispatch_s=window_dispatch_s,
+                         device_s=window_device_s,
+                         steps_in_dispatch=max(tick - window_start_tick, 1),
+                         warm=warm, queue_depth=len(eng.queue),
+                         active_seqs=sum(s is not None for s in eng.slots))
+                obs.heartbeat()
+                if base:
+                    _write_cursor(base, tick, done)
+                    _write_tick(base, tick)
+                window_t0 = now
+                window_start_tick = tick
+                window_device_s = window_dispatch_s = 0.0
+                window_tokens = 0
+        eng._emit_kv_cache()  # final pool-pressure snapshot
+        if base:
+            _write_cursor(base, tick, done)
+            _write_tick(base, tick)
+        status_extra = {"completed": eng.completed,
+                        "rejected": eng.rejected, "final_tick": tick}
+        return 0
+    finally:
+        obs.run_end(**status_extra)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
