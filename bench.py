@@ -131,6 +131,76 @@ def lm_geometry():
         grad_bucket_mb=float(os.environ.get("BENCH_GRAD_BUCKET_MB", "0")))
 
 
+_PLAN_BLOCK = None   # set by apply_bench_plan; rides in every headline JSON
+
+
+def apply_bench_plan():
+    """BENCH_PLAN=<plan JSON path>: drive this bench run from a tuned step
+    plan (tools/tune.py output, selected for this device kind) instead of
+    hand-set BENCH_* knobs. The plan's knobs are written INTO the BENCH_*
+    env (plan wins — that is the point) so the one geometry parse
+    (lm_geometry) stays the single source; the Pallas block sizes / fused
+    switch apply via plan.compile.activate_plan. The headline JSON gains a
+    'plan' block ({source, hash, knobs}) and tools/bench_track.py tracks
+    plan-tagged headlines independently. Returns the block (or None)."""
+    global _PLAN_BLOCK
+    spec = os.environ.get("BENCH_PLAN", "")
+    if not spec:
+        return None
+    import jax
+
+    from tpu_dist.models.registry import model_kind
+    from tpu_dist.plan.compile import activate_plan
+    from tpu_dist.plan.ir import (load_plan_file, plan_for_device,
+                                  plan_hash, plan_knob_summary)
+
+    kind = getattr(jax.devices()[0], "device_kind", "unknown")
+    plan = plan_for_device(load_plan_file(spec), kind)
+    engine = "lm" if model_kind(ARCH) == "lm" else "image"
+    if plan.engine != engine:
+        raise SystemExit(f"BENCH_PLAN={spec}: plan engine {plan.engine!r} "
+                         f"does not drive BENCH_ARCH={ARCH} ({engine})")
+    # the bench has no knob for these plan dimensions; silently dropping
+    # them while stamping the FULL plan hash would make bench_track gate
+    # a [plan:<hash>] series on numbers the plan did not produce — refuse
+    unmappable = {k: v for k, v in (
+        ("precision", plan.precision), ("health", plan.health),
+        ("grad_accum_steps", plan.grad_accum_steps),
+        ("window", plan.window if plan.window == "stacked" else "none"),
+    ) if v not in ("fp32", "record", 1, "none")}
+    if unmappable:
+        raise SystemExit(
+            f"BENCH_PLAN={spec}: plan {sorted(unmappable)} have no BENCH_* "
+            "knob — the headline would carry a plan hash the run did not "
+            "execute; re-emit the plan without them for benching")
+    os.environ["BENCH_QUANT"] = plan.quant
+    os.environ["BENCH_TP_IMPL"] = plan.tp_impl
+    os.environ["BENCH_GRAD_BUCKET_MB"] = str(plan.grad_bucket_mb)
+    if engine == "lm":
+        os.environ["BENCH_LOSS_CHUNK"] = str(plan.loss_chunk)
+    # plan wins over PRE-EXPORTED knobs too: a stale BENCH_STEPS_PER_WINDOW
+    # or BENCH_FUSED_QUANT from an earlier sweep must never leak into a
+    # plan-tagged headline (bench_track gates the [plan:<hash>] series on
+    # these numbers). window='none' / fused_quant='auto' mean "the bench's
+    # own default / the auto dispatch", so the env overrides are CLEARED
+    if plan.window != "none":
+        os.environ["BENCH_STEPS_PER_WINDOW"] = str(plan.steps_per_dispatch)
+    else:
+        os.environ.pop("BENCH_STEPS_PER_WINDOW", None)
+        os.environ.pop("BENCH_STEPS", None)
+    if plan.fused_quant != "auto":
+        os.environ["BENCH_FUSED_QUANT"] = (
+            "1" if plan.fused_quant == "on" else "0")
+    else:
+        os.environ.pop("BENCH_FUSED_QUANT", None)
+    activate_plan(plan)
+    _PLAN_BLOCK = {"source": spec, "hash": plan_hash(plan),
+                   "device_kind": kind, "knobs": plan_knob_summary(plan)}
+    print(f"bench plan: {_PLAN_BLOCK['hash']} from {spec} "
+          f"(device {kind}): {_PLAN_BLOCK['knobs']}", file=sys.stderr)
+    return _PLAN_BLOCK
+
+
 def apply_fused_quant_knob():
     """BENCH_FUSED_QUANT=1/0 forces the fused Pallas int8 kernel on/off
     (ops.quant.set_fused_quant; unset = auto: fused on TPU). Must run
@@ -453,6 +523,7 @@ def lm_bench():
         "prefetch": prefetch_stats,
         "health": health,
         "goodput": goodput_block(goodput_acc),
+        "plan": _PLAN_BLOCK,
         "ledger": ledger_path,
     }))
 
@@ -575,6 +646,10 @@ def main():
     jax.config.update("jax_compilation_cache_dir",
                       os.environ.get("JAX_CACHE_DIR", "/tmp/jaxcache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+    # a tuned plan (BENCH_PLAN) rewrites the BENCH_* knobs BEFORE the
+    # guards/geometry below read them
+    apply_bench_plan()
 
     from tpu_dist.models.registry import model_kind
     if model_kind(ARCH) == "lm":
@@ -740,6 +815,7 @@ def main():
             "prefetch": prefetch_stats,
             "health": health,
             "goodput": goodput_block(goodput_acc),
+            "plan": _PLAN_BLOCK,
             "ledger": ledger_path,
         }))
         return
@@ -776,6 +852,7 @@ def main():
         "prefetch": prefetch_stats,
         "health": health,
         "goodput": goodput_block(goodput_acc),
+        "plan": _PLAN_BLOCK,
         "ledger": ledger_path,
     }))
 

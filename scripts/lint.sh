@@ -116,6 +116,63 @@ assert FleetLedger({0: []}).hosts == {0: []}
 print("supervisor + consensus + fleet-scenario policy gates: OK (no jax)")
 EOF
 
+# Plan-IR + auto-tuner gate (round 15), jax-free BY CONSTRUCTION: the
+# step-plan IR and the tuner must import and run on a bare login/CI host
+# (tools/tune.py's whole point), and the tuner's output must be
+# DETERMINISTIC — the config knob, bench tags and ledger stamps all key
+# on the plan hash, so two identical searches must emit byte-identical
+# plan JSON. A stray `import jax` creeping into plan.ir / plan.tune
+# fails HERE.
+python - <<'EOF'
+import builtins, json
+
+_real = builtins.__import__
+def _guard(name, *a, **k):
+    if name == "jax" or name.startswith("jax."):
+        raise ImportError(f"plan gate: jax import blocked ({name})")
+    return _real(name, *a, **k)
+builtins.__import__ = _guard
+
+from tpu_dist.plan.ir import (Plan, PlanError, apply_plan_to_config,
+                              load_plan_file, plan_for_device, plan_hash)
+from tpu_dist.plan.tune import tune
+
+# IR round-trip + hash determinism + validation
+p = Plan(engine="lm", quant="int8", grad_bucket_mb=25.0, sync="explicit",
+         window="indexed", steps_per_dispatch=16,
+         quant_block=(256, 128, 0)).validate()
+assert Plan.from_json(p.to_json()) == p
+assert plan_hash(p) == plan_hash(Plan.from_json(p.to_json()))
+for bad in (dict(quant="int4"), dict(tp_impl="ring"),
+            dict(grad_bucket_mb=25.0), dict(quant_block=(100, 128, 0))):
+    try:
+        Plan(engine="lm", **bad).validate()
+    except PlanError:
+        pass
+    else:
+        raise AssertionError(f"accepted invalid plan {bad}")
+
+# the canned-measurement search, twice: byte-identical plan JSON
+text1, res1 = tune(measurement_files=["scripts/tune_ci.json"])
+text2, res2 = tune(measurement_files=["scripts/tune_ci.json"])
+assert text1 == text2, "tuner output is not deterministic"
+best = res1["TPU v5 lite"]["best"]
+assert best["measured"], "the canned trial must win (measured refinement)"
+doc = json.loads(text1)
+assert doc["plans"]["TPU v5 lite"]["hash"] == best["hash"]
+# the emitted file round-trips through the config knob's loader
+import os, tempfile
+fd, tmp = tempfile.mkstemp(suffix=".json"); os.close(fd)
+try:
+    with open(tmp, "w") as f:
+        f.write(text1)
+    sel = plan_for_device(load_plan_file(tmp), "TPU v5 lite")
+    assert plan_hash(sel) == best["hash"]
+finally:
+    os.unlink(tmp)
+print("plan IR + tuner gate: OK (no jax, deterministic)")
+EOF
+
 # Advisory tier-1 budget creep warning (never fails the gate): conftest
 # writes each full-suite run's wall time + top-20 durations to
 # /tmp/tier1_durations.json (TPU_DIST_TIER1_DURATIONS overrides); the

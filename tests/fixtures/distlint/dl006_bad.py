@@ -29,3 +29,9 @@ def emit_fleet_badly(ledger):
     # schema-checked like the rest
     ledger.emit("scenario", name="ci")               # missing seed/hosts/ticks
     ledger.emit("fleet", hosts_live=3)               # missing ratio/breaches
+
+
+def emit_plan_badly(ledger):
+    # round 15: the step-plan events (tpu_dist.plan) are schema-checked
+    ledger.emit("plan", source="plans.json")     # missing plan_hash/knobs
+    ledger.emit("tune", device_kind="v5e")       # missing candidates/best

@@ -48,3 +48,13 @@ def emit_fleet_well(ledger):
                 slo_breaches=None, final=False)
     ledger.emit("fleet", hosts_live=0, goodput_ratio=0.31, slo_breaches=4,
                 final=True)
+
+
+def emit_plan_well(ledger):
+    # round 15: the step-plan events (tpu_dist.plan) — the engines' plan
+    # stamp and tools/tune.py's per-device-kind search record
+    ledger.emit("plan", source="plans.json", plan_hash="c456df519e8b",
+                knobs={"quant": "int8"}, device_kind="cpu")
+    ledger.emit("tune", device_kind="cpu", candidates=72,
+                best_hash="c456df519e8b", best_step_s=0.0021,
+                measured=True, peaks_nominal=False)

@@ -163,6 +163,11 @@ def test_grad_compression_still_converges():
     assert last < first
 
 
+@pytest.mark.slow  # tier-1 budget (PR 15): the stacked and indexed windows
+# wrap the ONE step template through the ONE plan-compiler window pass now;
+# in-budget siblings: tests/test_plan.py::test_image_plan_loss_parity_
+# across_modes (stacked == sequential, bit-level) and
+# test_indexed_multi_step_equals_host_batches below (the indexed twin)
 def test_multi_step_equals_sequential_steps():
     """K steps in one scan dispatch == K sequential jit dispatches."""
     from tpu_dist.engine.steps import make_multi_train_step

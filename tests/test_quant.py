@@ -254,6 +254,11 @@ def test_wo_decode_params_are_int8_resident():
 
 # ---- sharded smoke ---------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget (PR 15): int8 under dp x tp rides the
+# ONE gspmd step template the plan compiler lowers for every GSPMD
+# placement — the in-budget siblings are the plan parity suite's int8 leg
+# (tests/test_plan.py::test_lm_plan_loss_parity_across_modes) and the fp
+# tp-placement parity (tests/test_lm.py::test_tp_matches_dp)
 def test_int8_train_step_under_dp_tp_mesh():
     """quant='int8' through the GSPMD dp x tp step: scales are tiny
     replicated leaves, so the Megatron param placement partitions the
@@ -299,7 +304,13 @@ def test_int8_train_step_under_dp_tp_mesh():
     pytest.param("1f1b", marks=pytest.mark.slow),
 ])
 @pytest.mark.parametrize("quant", [
-    "int8",
+    # tier-1 budget (PR 15): the whole quant x pp cross matrix is
+    # slow-tier now — pp forwards the knob into its stage blocks through
+    # the SAME ops.quant.quant_matmul the plan-compiled dense paths pin
+    # in-budget (tests/test_plan.py::test_lm_plan_loss_parity_across_modes
+    # int8 leg + test_quant_einsum_tracks_fp_dense), and the pp schedules'
+    # own parity stays in-budget in test_pp
+    pytest.param("int8", marks=pytest.mark.slow),
     # tier-1 budget (PR 7): int8_wo x pp is an 11s near-duplicate of the
     # int8 x pp parity (wo-mode itself is parity-pinned in the decode and
     # dense-layer tests); slow-marked

@@ -95,8 +95,18 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
         # fleet block abstains, exactly the data_s/serving convention
         fleet = (parsed.get("fleet")
                  if isinstance(parsed.get("fleet"), dict) else {})
+        # tuned step plans (tpu_dist.plan, round 15+): a headline driven
+        # by BENCH_PLAN carries a plan block — its metric tracks under a
+        # [plan:<hash>]-tagged name so plan-tuned runs gate against THEIR
+        # OWN history and pre-plan points abstain, exactly the quant/
+        # tp_impl naming convention (variants never gate the bf16 line)
+        plan = (parsed.get("plan")
+                if isinstance(parsed.get("plan"), dict) else None)
+        metric = parsed["metric"]
+        if plan and plan.get("hash"):
+            metric = f"{metric}[plan:{plan['hash']}]"
         points.append({
-            "metric": parsed["metric"],
+            "metric": metric,
             "value": value,
             "unit": parsed.get("unit"),
             "mfu": parsed.get("mfu"),

@@ -1008,6 +1008,20 @@ class CallGraph:
                         tq = self.module_funcs.get((n.rel, rc))
                         if tq is None and n.parent is not None:
                             tq = n.parent.children.get(rc)
+                        if tq is None:
+                            # cross-module factory chain through an import
+                            # alias (`from plan.compile import
+                            # compile_train_step` inside the shim body):
+                            # the make_* builders return the plan
+                            # compiler's product since round 15, so the
+                            # chain must survive the module boundary —
+                            # a plain table lookup, no resolve() recursion
+                            target = n.aliases.get(rc)
+                            if target and "." in target:
+                                mod, _, fname = target.rpartition(".")
+                                rel = self.module_of.get(mod)
+                                if rel is not None:
+                                    tq = self.module_funcs.get((rel, fname))
                         if tq in fac:
                             fac.add(q)
                             changed = True

@@ -398,7 +398,29 @@ def summarize(records, out=print):
             + (" (MFU vs NOMINAL peak)" if r.get("peak_is_nominal") else ""))
         summary["run"] = {k: r.get(k) for k in
                           ("kind", "devices", "mesh", "process_count",
-                           "peak_tflops", "peak_is_nominal", "jax_version")}
+                           "peak_tflops", "peak_is_nominal", "jax_version",
+                           "plan_hash", "plan_source", "plan_knobs")}
+    # resolved step plan (tpu_dist.plan): which tuned/loaded plan drove the
+    # step compilation — the tuner's measured-refinement loop reads this
+    # back (tools/tune.py --ledger-summary keys trials on run.plan_hash)
+    plans = [r for r in records if r["event"] == "plan"]
+    for r in plans[-1:]:
+        out(f"plan: {r.get('plan_hash')} from {r.get('source')}"
+            + (f" (device {r['device_kind']})" if r.get("device_kind")
+               else "")
+            + (f"\n  knobs: {r.get('knobs')}" if r.get("knobs") else ""))
+        summary["plan"] = {k: r.get(k) for k in
+                           ("source", "plan_hash", "knobs", "device_kind")}
+    # auto-tuner invocations appended to this ledger (tools/tune.py)
+    tunes = [r for r in records if r["event"] == "tune"]
+    if tunes:
+        for r in tunes:
+            out(f"tune: {r.get('device_kind')}: best {r.get('best_hash')} "
+                f"over {r.get('candidates')} candidate(s)"
+                + (" [measured]" if r.get("measured") else " [analytic]"))
+        summary["tune"] = [{k: r.get(k) for k in
+                            ("device_kind", "candidates", "best_hash",
+                             "best_step_s", "measured")} for r in tunes]
     if ends:
         secs = ends[-1]["seconds"]
         status = ends[-1].get("status") or "ok"

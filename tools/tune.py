@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Auto-tune a step plan per device kind from measured artifacts.
+
+    python -m tools.tune --comm-bench comm.json --out plans.json
+    python -m tools.tune --comm-bench comm.json --json        # plan JSON
+    python -m tools.tune --device-kind "TPU v5 lite" --device-kind v4
+    python -m tools.tune --ledger-summary report.json         # refinement
+    python -m tools.tune --workload '{"n_params": 9e8, ...}'  # geometry
+
+The ROADMAP item-2 search (tpu_dist.plan.tune): enumerate the step-plan
+space (quant x fused kernel x grad buckets x dispatch window x Pallas
+block sizes), prune illegal combinations via the plan IR's validator,
+score each candidate with the roofline cost model at the device peaks,
+fold in ``tools/comm_bench.py --json`` sweep measurements for the
+collective costs, and optionally refine with measured trials —
+``tools/ledger_report.py --json`` summaries of short plan-stamped runs
+(their MFU overrides the analytic score for the matching plan), or a
+``trials`` list in the measurement file keyed by knob subsets.
+
+Output: the best-plan-per-device-kind JSON the configs' ``plan`` knob
+accepts (``--out`` writes it, ``--json`` prints it). DETERMINISTIC BY
+CONTRACT: the same inputs produce byte-identical output (fixed space
+order, pure-arithmetic scores, hash tie-breaks) — scripts/lint.sh runs
+this twice over a canned measurement file and asserts it. ``--ledger``
+appends one ``tune`` event per device kind for run forensics.
+
+Stdlib + tpu_dist.plan only — NO jax: runs on a login host, in CI,
+anywhere. The device is named by its kind string (the PEAK_TFLOPS /
+PEAK_GBPS table keys); a comm_bench file's ``device_kind`` is the
+default.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--comm-bench", action="append", default=[],
+                    metavar="JSON",
+                    help="tools/comm_bench.py --json sweep file(s); later "
+                    "files extend the first's results/trials")
+    ap.add_argument("--ledger-summary", action="append", default=[],
+                    metavar="JSON",
+                    help="tools/ledger_report.py --json summaries of short "
+                    "plan-stamped runs (measured refinement)")
+    ap.add_argument("--device-kind", action="append", default=[],
+                    help="device kind(s) to emit plans for (default: the "
+                    "measurement file's device_kind, else 'unknown')")
+    ap.add_argument("--workload", default="",
+                    help="workload JSON object/string: n_params, "
+                    "tokens_per_step, devices, engine (defaults: the r06 "
+                    "LM bench geometry)")
+    ap.add_argument("--out", default="",
+                    help="write the plan JSON here (the config knob's "
+                    "input)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the plan JSON on stdout (the human table "
+                    "moves to stderr)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="how many ranked candidates to show per device "
+                    "kind (default 5)")
+    ap.add_argument("--ledger", default="",
+                    help="append one 'tune' obs.ledger event per device "
+                    "kind here")
+    args = ap.parse_args(argv)
+
+    from tpu_dist.plan.tune import tune
+
+    workload = json.loads(args.workload) if args.workload else None
+    text, results = tune(measurement_files=args.comm_bench,
+                         ledger_summary_files=args.ledger_summary,
+                         device_kinds=args.device_kind or None,
+                         workload=workload)
+
+    say = ((lambda *a, **k: print(*a, file=sys.stderr, **k))
+           if args.json else print)
+    for kind, res in sorted(results.items()):
+        peaks = res["peaks"]
+        say(f"{kind}: {res['candidates']} candidate plan(s) at "
+            f"{peaks['tflops']:g} TFLOP/s / {peaks['gbps']:g} GB/s"
+            + (" (NOMINAL peaks)" if peaks["nominal"] else "")
+            + (f"; comm: {res['comm']}" if res["comm"] else
+               "; no comm measurements (analytic only)"))
+        for i, cand in enumerate(res["ranked"][:max(args.top, 1)]):
+            from tpu_dist.plan.ir import plan_knob_summary
+            knobs = plan_knob_summary(cand["plan"]) or "(all defaults)"
+            say(f"  #{i + 1} {cand['hash']}  {cand['step_s'] * 1e3:9.3f} "
+                f"ms/step{' [measured]' if cand['measured'] else ''}  "
+                f"{knobs}")
+    if args.ledger:
+        from tpu_dist.obs.ledger import Ledger
+
+        led = Ledger(args.ledger)
+        for kind, res in sorted(results.items()):
+            best = res["best"]
+            led.emit("tune", device_kind=kind,
+                     candidates=res["candidates"],
+                     best_hash=best["hash"] if best else None,
+                     best_step_s=best["step_s"] if best else None,
+                     measured=bool(best and best["measured"]),
+                     peaks_nominal=res["peaks"]["nominal"])
+        led.close()
+        say(f"ledger: {args.ledger}")
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        say(f"plan file: {args.out}")
+    if args.json:
+        sys.stdout.write(text)
+    if not args.out and not args.json:
+        say("(no --out/--json: dry run — the table above is the result)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -196,6 +196,15 @@ class TrainConfig:
     crash_loop_k: int = 3              # stop restarting after K
                                        # consecutive pre-first-step deaths
 
+    # -- step plan (tpu_dist.plan): "" | "none" = hand-set knobs; "auto"
+    #    = the tuner's analytic search for this device kind (pruned to
+    #    what this config can run); a path = a tools/tune.py plan JSON
+    #    (best-plan-per-device-kind). The plan-owned knobs (quant,
+    #    tp_impl, grad_bucket_mb, steps_per_dispatch, health, precision,
+    #    variant, Pallas block sizes) are overridden before the engine
+    #    builds steps; run_start + a 'plan' ledger event record the hash
+    plan: str = ""
+
     # -- synthetic-data knobs (TPU-only: zero-egress envs can't download datasets)
     synth_train_size: int = 50000
     synth_val_size: int = 10000
@@ -363,6 +372,16 @@ class LMConfig:
     restart_backoff_s: float = 1.0 # restart backoff base (doubles, cap 60s)
     crash_loop_k: int = 3          # crash-loop cutoff: K consecutive
                                    # pre-first-step deaths stop the loop
+    plan: str = ""                 # step plan (tpu_dist.plan): "" | "none"
+                                   # = hand-set knobs; "auto" = analytic
+                                   # tuner search for this device kind; a
+                                   # path = a tools/tune.py plan JSON —
+                                   # plan-owned knobs (quant/tp_impl/
+                                   # grad_bucket_mb/steps_per_dispatch/
+                                   # loss_chunk/health/precision/blocks)
+                                   # override before steps build; the
+                                   # hash lands in run_start + a 'plan'
+                                   # ledger event
 
 
 def add_args(parser: argparse.ArgumentParser, defaults) -> None:

@@ -53,6 +53,12 @@ class LMTrainer:
     """One engine for every LM parallelism flavor; mode picked by the mesh."""
 
     def __init__(self, cfg: LMConfig, mesh=None):
+        # step plan (tpu_dist.plan): the `plan` knob rewrites the
+        # plan-owned config fields and flips the trace-time kernel
+        # switches BEFORE anything below reads them; run_start + a
+        # 'plan' ledger event record the resolved hash
+        from tpu_dist.plan.compile import resolve_config_plan
+        cfg, self._plan_info = resolve_config_plan(cfg)
         self.cfg = cfg
         if cfg.resume and not os.path.exists(cfg.resume):
             raise FileNotFoundError(f"--resume checkpoint not found: {cfg.resume}")
@@ -374,7 +380,8 @@ class LMTrainer:
                                      # its wall time is excluded from tok/s
         # run observability: ledger + tracer + skew monitor + hang watchdog
         # (obs.RunObs) — the LM engine's step records carry tok/s + MFU
-        self.obs = RunObs("lm", cfg, self.mesh, unit="tok/s")
+        self.obs = RunObs("lm", cfg, self.mesh, unit="tok/s",
+                          plan_info=self._plan_info)
         # whether the int8 matmuls route through the fused Pallas kernel
         # (ops.pallas_quant) — trace-time static, so ONE read here is the
         # truth for every step record; ledger_report attributes MFU deltas

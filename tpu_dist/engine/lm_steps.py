@@ -2,7 +2,21 @@
 
 Extends the image engine (tpu_dist.engine.steps) to token sequences — the
 long-context, model-parallel half of the framework the reference never had.
-Three step builders over the same TransformerLM weights:
+
+Since round 15 this module holds the LM engine's step TEMPLATES — the ONE
+shared objective (:func:`_lm_grads_and_metrics`) wrapped as the gspmd
+template (:func:`_lm_step_fn`) and its explicit/ring/sp per-device flavors
+(:func:`_lm_explicit_dp_step_fn` / :func:`_lm_tp_ring_step_fn` /
+:func:`_lm_sp_step_fn`) — plus the eval kernel. Every public ``make_lm_*``
+builder below is a THIN SHIM over the plan compiler
+(``tpu_dist.plan.compile``): it names its variant as a declarative
+:class:`tpu_dist.plan.ir.Plan` and the compiler's validate/template/
+window/partition passes produce the callable (the jit/shard_map/scan
+wrapper bodies live once, in the compiler). Signatures and math are
+unchanged; loss/param parity with the pre-plan builders is pinned
+bit-for-bit in tests/test_plan.py.
+
+Builder map (mode selection is by mesh axes, exactly like scripts/8):
 
 * :func:`make_lm_train_step` — jit over a (data[, model]) mesh. Batch sharded
   on 'data'; with TP param shardings (tpu_dist.parallel.tp) GSPMD emits the
@@ -24,19 +38,18 @@ by the shift itself.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from tpu_dist._compat import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from tpu_dist.engine.state import TrainState
 from tpu_dist.engine.steps import _apply_update
 from tpu_dist.ops.fused_xent import chunked_softmax_xent
 from tpu_dist.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from tpu_dist.plan.ir import Plan
 
 
 LM_METRIC_KEYS = ("loss_sum", "correct1", "count")
@@ -177,71 +190,7 @@ def _lm_step_fn(model, tx, aux_weight: float, loss_chunk: int = 0,
     return step
 
 
-def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
-                       aux_weight: float = 0.01,
-                       donate: bool = True, loss_chunk: int = 0,
-                       health: str = "record") -> Callable:
-    """jit step for DP — and for DP x TP / FSDP / EP when the TrainState was
-    placed with the matching sharding helper (GSPMD propagates the param
-    layout and emits the collectives; the step code is identical).
-    ``aux_weight`` scales any sown MoE load-balancing losses."""
-    repl = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, P(data_axis))
-
-    # With TP the state arrives pre-sharded (tpu_dist.parallel.tp.shard_lm_params)
-    # and in_shardings=None lets GSPMD propagate that layout through the step;
-    # pure DP states arrive replicated — same jit serves both.
-    return jax.jit(_lm_step_fn(model, tx, aux_weight, loss_chunk, health),
-                   in_shardings=(None, batch_sh, batch_sh, repl),
-                   out_shardings=None,
-                   donate_argnums=(0,) if donate else ())
-
-
-def make_lm_grad_accum_train_step(model, tx, mesh: Mesh,
-                                  data_axis: str = DATA_AXIS,
-                                  aux_weight: float = 0.01,
-                                  donate: bool = True,
-                                  loss_chunk: int = 0,
-                                  health: str = "record") -> Callable:
-    """ONE optimizer step from K microbatches (gradient accumulation), the
-    LM twin of steps.py make_grad_accum_train_step.
-
-    signature: (state, inputs (K, B, L), targets (K, B, L), rng) -> (state,
-    metric sums over microbatches). Grads average over the K microbatches
-    inside a lax.scan, then apply once — for global token batches beyond
-    device memory. Equal microbatch sizes make the average of per-micro
-    means equal the full-batch mean; dropout folds a per-microbatch index
-    on top of the usual state.step fold.
-    """
-    repl = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, P(None, data_axis))
-
-    def step(state: TrainState, inputs, targets, rng):
-        k = inputs.shape[0]
-        dropout_rng = jax.random.fold_in(rng, state.step)
-
-        def micro(carry, batch):
-            grads_acc, i = carry
-            mb_in, mb_tg = batch
-            grads, metrics = _lm_grads_and_metrics(
-                model, aux_weight, state.params, mb_in, mb_tg,
-                jax.random.fold_in(dropout_rng, i), loss_chunk)
-            grads_acc = jax.tree.map(lambda a, g: a + g / k, grads_acc, grads)
-            return (grads_acc, i + 1), metrics
-
-        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
-                             state.params)
-        (grads, _), metrics_k = jax.lax.scan(
-            micro, (zeros, jnp.int32(0)), (inputs, targets))
-        metrics = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
-        return _apply_update(tx, state, grads, {}, metrics, health)
-
-    return jax.jit(step, in_shardings=(None, batch_sh, batch_sh, repl),
-                   out_shardings=(None, repl),
-                   donate_argnums=(0,) if donate else ())
-
-
-# ---- explicit-collective dp + ring-TP steps (parallel.overlap) -------------
+# ---- explicit-collective per-device step templates (parallel.overlap) ------
 
 def _lm_explicit_dp_step_fn(model, tx, aux_weight: float, data_axis: str,
                             axis_size: int, grad_bucket_mb: float,
@@ -319,81 +268,6 @@ def _lm_tp_ring_step_fn(model, tx, aux_weight: float, data_axis: str,
     return step
 
 
-def _wrap_explicit_step(step_fn, mesh: Mesh, data_axis: str,
-                        donate: bool) -> Callable:
-    """shard_map + jit one of the explicit per-device LM step fns: state
-    and rng replicated, token batch sharded on 'data' (full sequence —
-    ring slices its own chunk), TrainState donated."""
-    sharded = shard_map(
-        step_fn, mesh=mesh,
-        in_specs=(P(), P(data_axis), P(data_axis), P()),
-        out_specs=(P(), P()),
-        check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
-
-
-def make_lm_shard_map_train_step(model, tx, mesh: Mesh,
-                                 data_axis: str = DATA_AXIS,
-                                 aux_weight: float = 0.01,
-                                 grad_bucket_mb: float = 25.0,
-                                 donate: bool = True,
-                                 loss_chunk: int = 0,
-                                 health: str = "record") -> Callable:
-    """Explicit-collective dp LM step — the LM twin of steps.py
-    make_shard_map_train_step, carrying the ``grad_bucket_mb`` knob:
-    gradient sync as independent ~25MB bucket reduce-scatters (DDP's
-    overlap decomposition) instead of whatever single fused all-reduce
-    GSPMD would emit. bucket_mb <= 0 keeps one monolithic pmean."""
-    step = _lm_explicit_dp_step_fn(model, tx, aux_weight, data_axis,
-                                   mesh.shape[data_axis], grad_bucket_mb,
-                                   loss_chunk, health)
-    return _wrap_explicit_step(step, mesh, data_axis, donate)
-
-
-def make_lm_tp_ring_train_step(model, tx, mesh: Mesh,
-                               data_axis: str = DATA_AXIS,
-                               model_axis: str = MODEL_AXIS,
-                               aux_weight: float = 0.01,
-                               donate: bool = True,
-                               loss_chunk: int = 0,
-                               health: str = "record") -> Callable:
-    """dp x TP step over the ring collective matmul (tp_impl='ring'):
-    shard_map over (data, model), batch sharded on 'data', the model's
-    ppermute rings running over 'model'. ``model`` must be built with
-    tp_impl='ring'. Loss parity with the GSPMD TP step is exact for fp
-    (tests/test_overlap.py); int8 quantizes per feature shard (finer
-    granularity than GSPMD's global per-row amax), so quant parity is
-    loss-level, not bitwise."""
-    step = _lm_tp_ring_step_fn(model, tx, aux_weight, data_axis, model_axis,
-                               mesh.shape[model_axis], loss_chunk, health)
-    return _wrap_explicit_step(step, mesh, data_axis, donate)
-
-
-def make_lm_explicit_indexed_multi_train_step(step_fn, mesh: Mesh,
-                                              data_axis: str = DATA_AXIS,
-                                              donate: bool = True) -> Callable:
-    """K steps per dispatch for the explicit-collective LM steps
-    (_lm_explicit_dp_step_fn / _lm_tp_ring_step_fn): a lax.scan over
-    (K, B) index windows INSIDE the shard_map program, gathering rows from
-    the HBM-resident (N, L+1) matrix and shifting on device — the explicit
-    twin of make_lm_indexed_multi_train_step, same signature:
-    (state, rows_all REPLICATED, idx (K, B) sharded (None, data), rng)."""
-
-    def per_device(state: TrainState, rows_all, idx, rng):
-        def body(st, idx_b):
-            rows = jnp.take(rows_all, idx_b, axis=0)     # (B_local, L+1)
-            return step_fn(st, rows[:, :-1], rows[:, 1:], rng)
-        state, metrics_k = jax.lax.scan(body, state, idx)
-        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
-
-    sharded = shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(), P(), P(None, data_axis), P()),
-        out_specs=(P(), P()),
-        check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
-
-
 def _lm_eval_metrics(model, params, inputs, targets, mask,
                      loss_chunk: int = 0, pos_offset=0):
     """Forward-only metric sums, chunked-head when loss_chunk > 0 — the
@@ -409,120 +283,6 @@ def _lm_eval_metrics(model, params, inputs, targets, mask,
                          pos_offset=pos_offset)
     _, metrics = lm_loss_and_metrics(logits, targets, mask)
     return metrics
-
-
-def make_lm_eval_step(model, mesh: Mesh, data_axis: str = DATA_AXIS,
-                      loss_chunk: int = 0) -> Callable:
-    """Forward-only metric sums on a held-out shard: (params, inputs,
-    targets, valid) -> {loss_sum, correct1, count}. ``valid`` (B,) 0/1
-    excludes sampler wrap-padding rows so perplexity is exact (the same
-    masking contract as the image eval, steps.py make_eval_step). Works for
-    any GSPMD placement the params carry (dp / fsdp / tp / ep)."""
-    batch_sh = NamedSharding(mesh, P(data_axis))
-
-    def step(params, inputs, targets, valid):
-        mask = jnp.broadcast_to(valid[:, None], targets.shape).astype(
-            jnp.float32)
-        return _lm_eval_metrics(model, params, inputs, targets, mask,
-                                loss_chunk)
-
-    return jax.jit(step, in_shardings=(None, batch_sh, batch_sh, batch_sh),
-                   out_shardings=NamedSharding(mesh, P()))
-
-
-def make_lm_indexed_multi_train_step(model, tx, mesh: Mesh,
-                                     data_axis: str = DATA_AXIS,
-                                     aux_weight: float = 0.01,
-                                     donate: bool = True,
-                                     loss_chunk: int = 0,
-                                     health: str = "record") -> Callable:
-    """K optimizer steps per dispatch from an HBM-RESIDENT token corpus.
-
-    signature: (state, rows_all (N, L+1) i32 REPLICATED, idx (K, B) i32
-    sharded (None, data), rng) -> (state, metrics summed over K steps).
-
-    The LM twin of steps.py make_indexed_multi_train_step: the whole row
-    matrix lives on device once, each scan iteration gathers its (B, L+1)
-    batch at HBM bandwidth and shifts inputs/targets ON DEVICE, and the host
-    sends only the index window — so LM training throughput tracks the
-    device step rate, not the host link. Identical math to K sequential
-    make_lm_train_step calls (same per-step rng fold). Works under any
-    GSPMD param placement (dp / fsdp / tp / ep) like the single step.
-    """
-    repl = NamedSharding(mesh, P())
-    idx_sh = NamedSharding(mesh, P(None, data_axis))
-    one_step = _lm_step_fn(model, tx, aux_weight, loss_chunk, health)
-
-    def multi(state: TrainState, rows_all, idx, rng):
-        def body(st, idx_b):
-            rows = jnp.take(rows_all, idx_b, axis=0)     # (B, L+1)
-            return one_step(st, rows[:, :-1], rows[:, 1:], rng)
-        state, metrics_k = jax.lax.scan(body, state, idx)
-        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
-
-    return jax.jit(multi, in_shardings=(None, repl, idx_sh, repl),
-                   out_shardings=(None, repl),
-                   donate_argnums=(0,) if donate else ())
-
-
-def make_lm_indexed_eval_step(model, mesh: Mesh,
-                              data_axis: str = DATA_AXIS,
-                              loss_chunk: int = 0) -> Callable:
-    """Whole-val-set perplexity in ONE dispatch from HBM-resident rows.
-
-    signature: (params, rows_all (N, L+1) REPLICATED, idx (K, B) i32 sharded
-    (None, data), valid (K, B) f32 same sharding) -> summed metrics over all
-    K batches, sampler padding masked per row."""
-    repl = NamedSharding(mesh, P())
-    idx_sh = NamedSharding(mesh, P(None, data_axis))
-
-    def step(params, rows_all, idx, valid):
-        def body(sums, blk):
-            idx_b, valid_b = blk
-            rows = jnp.take(rows_all, idx_b, axis=0)
-            inputs, targets = rows[:, :-1], rows[:, 1:]
-            mask = jnp.broadcast_to(valid_b[:, None], targets.shape).astype(
-                jnp.float32)
-            m = _lm_eval_metrics(model, params, inputs, targets, mask,
-                                 loss_chunk)
-            return jax.tree.map(jnp.add, sums, m), None
-
-        sums, _ = jax.lax.scan(body, zeros_lm_metrics(), (idx, valid))
-        return sums
-
-    return jax.jit(step, in_shardings=(None, repl, idx_sh, idx_sh),
-                   out_shardings=repl)
-
-
-def make_lm_sp_eval_step(model_ctor: Callable, mesh: Mesh,
-                         data_axis: str = DATA_AXIS,
-                         seq_axis: str = SEQ_AXIS,
-                         loss_chunk: int = 0) -> Callable:
-    """Held-out eval under sequence parallelism: (params, inputs, targets,
-    valid) with (data, seq)-sharded tokens, ring attention, metric sums
-    psum'd over BOTH axes — closing the round-2 gap where sp had no eval."""
-    from tpu_dist.parallel.ring_attention import ring_attention_fn
-
-    model = model_ctor(attn_fn=ring_attention_fn(seq_axis))
-
-    def per_device(params, inputs, targets, valid):
-        seq_idx = jax.lax.axis_index(seq_axis)
-        pos_offset = seq_idx * inputs.shape[1]
-        mask = jnp.broadcast_to(valid[:, None], targets.shape).astype(
-            jnp.float32)
-        metrics = _lm_eval_metrics(model, params, inputs, targets, mask,
-                                   loss_chunk, pos_offset)
-        return jax.tree.map(
-            lambda m: jax.lax.psum(jax.lax.psum(m, seq_axis), data_axis),
-            metrics)
-
-    sharded = shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis),
-                  P(data_axis)),
-        out_specs=P(),
-        check_vma=False)
-    return jax.jit(sharded)
 
 
 def _lm_sp_step_fn(model, tx, aux_weight: float, data_axis: str,
@@ -583,6 +343,173 @@ def _sp_window_slices(rows, seq_idx, shard_len):
     return inputs, targets
 
 
+# ---- the make_lm_* builders: thin shims over the plan compiler -------------
+# (plain `return f(...)` chains on purpose: distlint's jit-factory
+# fixpoint follows them, so the engines' loops still derive as hot)
+
+def _train(plan: Plan, **binds):
+    from tpu_dist.plan.compile import Bindings, compile_train_step
+    return compile_train_step(plan, Bindings(**binds))
+
+
+def _eval(plan: Plan, **binds):
+    from tpu_dist.plan.compile import Bindings, compile_eval_step
+    return compile_eval_step(plan, Bindings(**binds))
+
+
+def make_lm_train_step(model, tx, mesh: Mesh, data_axis: str = DATA_AXIS,
+                       aux_weight: float = 0.01,
+                       donate: bool = True, loss_chunk: int = 0,
+                       health: str = "record") -> Callable:
+    """jit step for DP — and for DP x TP / FSDP / EP when the TrainState was
+    placed with the matching sharding helper (GSPMD propagates the param
+    layout and emits the collectives; the step code is identical).
+    ``aux_weight`` scales any sown MoE load-balancing losses."""
+    plan = Plan(engine="lm", data_axis=data_axis, aux_weight=aux_weight,
+                donate=donate, loss_chunk=loss_chunk, health=health)
+    return _train(plan, mesh=mesh, model=model, tx=tx)
+
+
+def make_lm_grad_accum_train_step(model, tx, mesh: Mesh,
+                                  data_axis: str = DATA_AXIS,
+                                  aux_weight: float = 0.01,
+                                  donate: bool = True,
+                                  loss_chunk: int = 0,
+                                  health: str = "record") -> Callable:
+    """ONE optimizer step from K microbatches (gradient accumulation), the
+    LM twin of steps.py make_grad_accum_train_step.
+
+    signature: (state, inputs (K, B, L), targets (K, B, L), rng) -> (state,
+    metric sums over microbatches). Grads average over the K microbatches
+    inside a lax.scan, then apply once — for global token batches beyond
+    device memory. Equal microbatch sizes make the average of per-micro
+    means equal the full-batch mean; dropout folds a per-microbatch index
+    on top of the usual state.step fold.
+    """
+    # grad_accum_steps > 1 selects the accum template (K itself is read
+    # from the stacked batch's leading dim at trace time)
+    plan = Plan(engine="lm", grad_accum_steps=2, data_axis=data_axis,
+                aux_weight=aux_weight, donate=donate, loss_chunk=loss_chunk,
+                health=health)
+    return _train(plan, mesh=mesh, model=model, tx=tx)
+
+
+def make_lm_shard_map_train_step(model, tx, mesh: Mesh,
+                                 data_axis: str = DATA_AXIS,
+                                 aux_weight: float = 0.01,
+                                 grad_bucket_mb: float = 25.0,
+                                 donate: bool = True,
+                                 loss_chunk: int = 0,
+                                 health: str = "record") -> Callable:
+    """Explicit-collective dp LM step — the LM twin of steps.py
+    make_shard_map_train_step, carrying the ``grad_bucket_mb`` knob:
+    gradient sync as independent ~25MB bucket reduce-scatters (DDP's
+    overlap decomposition) instead of whatever single fused all-reduce
+    GSPMD would emit. bucket_mb <= 0 keeps one monolithic pmean."""
+    plan = Plan(engine="lm", sync="explicit", data_axis=data_axis,
+                aux_weight=aux_weight, grad_bucket_mb=grad_bucket_mb,
+                donate=donate, loss_chunk=loss_chunk, health=health)
+    return _train(plan, mesh=mesh, model=model, tx=tx)
+
+
+def make_lm_tp_ring_train_step(model, tx, mesh: Mesh,
+                               data_axis: str = DATA_AXIS,
+                               model_axis: str = MODEL_AXIS,
+                               aux_weight: float = 0.01,
+                               donate: bool = True,
+                               loss_chunk: int = 0,
+                               health: str = "record") -> Callable:
+    """dp x TP step over the ring collective matmul (tp_impl='ring'):
+    shard_map over (data, model), batch sharded on 'data', the model's
+    ppermute rings running over 'model'. ``model`` must be built with
+    tp_impl='ring'. Loss parity with the GSPMD TP step is exact for fp
+    (tests/test_overlap.py); int8 quantizes per feature shard (finer
+    granularity than GSPMD's global per-row amax), so quant parity is
+    loss-level, not bitwise."""
+    plan = Plan(engine="lm", sync="explicit", layout="tp", tp_impl="ring",
+                data_axis=data_axis, model_axis=model_axis,
+                aux_weight=aux_weight, donate=donate, loss_chunk=loss_chunk,
+                health=health)
+    return _train(plan, mesh=mesh, model=model, tx=tx)
+
+
+def make_lm_explicit_indexed_multi_train_step(step_fn, mesh: Mesh,
+                                              data_axis: str = DATA_AXIS,
+                                              donate: bool = True) -> Callable:
+    """K steps per dispatch for the explicit-collective LM steps
+    (_lm_explicit_dp_step_fn / _lm_tp_ring_step_fn): a lax.scan over
+    (K, B) index windows INSIDE the shard_map program, gathering rows from
+    the HBM-resident (N, L+1) matrix and shifting on device — the explicit
+    twin of make_lm_indexed_multi_train_step, same signature:
+    (state, rows_all REPLICATED, idx (K, B) sharded (None, data), rng)."""
+    plan = Plan(engine="lm", sync="explicit", window="indexed",
+                steps_per_dispatch=2,  # K is read from the index window
+                data_axis=data_axis, donate=donate)
+    return _train(plan, mesh=mesh, explicit_step_fn=step_fn)
+
+
+def make_lm_eval_step(model, mesh: Mesh, data_axis: str = DATA_AXIS,
+                      loss_chunk: int = 0) -> Callable:
+    """Forward-only metric sums on a held-out shard: (params, inputs,
+    targets, valid) -> {loss_sum, correct1, count}. ``valid`` (B,) 0/1
+    excludes sampler wrap-padding rows so perplexity is exact (the same
+    masking contract as the image eval, steps.py make_eval_step). Works for
+    any GSPMD placement the params carry (dp / fsdp / tp / ep)."""
+    plan = Plan(engine="lm", data_axis=data_axis, loss_chunk=loss_chunk)
+    return _eval(plan, mesh=mesh, model=model)
+
+
+def make_lm_indexed_multi_train_step(model, tx, mesh: Mesh,
+                                     data_axis: str = DATA_AXIS,
+                                     aux_weight: float = 0.01,
+                                     donate: bool = True,
+                                     loss_chunk: int = 0,
+                                     health: str = "record") -> Callable:
+    """K optimizer steps per dispatch from an HBM-RESIDENT token corpus.
+
+    signature: (state, rows_all (N, L+1) i32 REPLICATED, idx (K, B) i32
+    sharded (None, data), rng) -> (state, metrics summed over K steps).
+
+    The LM twin of steps.py make_indexed_multi_train_step: the whole row
+    matrix lives on device once, each scan iteration gathers its (B, L+1)
+    batch at HBM bandwidth and shifts inputs/targets ON DEVICE, and the host
+    sends only the index window — so LM training throughput tracks the
+    device step rate, not the host link. Identical math to K sequential
+    make_lm_train_step calls (same per-step rng fold). Works under any
+    GSPMD param placement (dp / fsdp / tp / ep) like the single step.
+    """
+    plan = Plan(engine="lm", window="indexed", steps_per_dispatch=2,
+                data_axis=data_axis, aux_weight=aux_weight, donate=donate,
+                loss_chunk=loss_chunk, health=health)
+    return _train(plan, mesh=mesh, model=model, tx=tx)
+
+
+def make_lm_indexed_eval_step(model, mesh: Mesh,
+                              data_axis: str = DATA_AXIS,
+                              loss_chunk: int = 0) -> Callable:
+    """Whole-val-set perplexity in ONE dispatch from HBM-resident rows.
+
+    signature: (params, rows_all (N, L+1) REPLICATED, idx (K, B) i32 sharded
+    (None, data), valid (K, B) f32 same sharding) -> summed metrics over all
+    K batches, sampler padding masked per row."""
+    plan = Plan(engine="lm", window="indexed", steps_per_dispatch=2,
+                data_axis=data_axis, loss_chunk=loss_chunk)
+    return _eval(plan, mesh=mesh, model=model)
+
+
+def make_lm_sp_eval_step(model_ctor: Callable, mesh: Mesh,
+                         data_axis: str = DATA_AXIS,
+                         seq_axis: str = SEQ_AXIS,
+                         loss_chunk: int = 0) -> Callable:
+    """Held-out eval under sequence parallelism: (params, inputs, targets,
+    valid) with (data, seq)-sharded tokens, ring attention, metric sums
+    psum'd over BOTH axes — closing the round-2 gap where sp had no eval."""
+    plan = Plan(engine="lm", layout="sp", sync="explicit",
+                data_axis=data_axis, seq_axis=seq_axis,
+                loss_chunk=loss_chunk)
+    return _eval(plan, mesh=mesh, model_ctor=model_ctor)
+
+
 def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,
                           data_axis: str = DATA_AXIS,
                           seq_axis: str = SEQ_AXIS,
@@ -596,18 +523,11 @@ def make_lm_sp_train_step(model_ctor: Callable, tx, mesh: Mesh,
     the ring can be bound per-axis (tpu_dist.models.transformer.tiny_lm or a
     partial of TransformerLM).
     """
-    from tpu_dist.parallel.ring_attention import ring_attention_fn
-
-    model = model_ctor(attn_fn=ring_attention_fn(seq_axis))
-    per_device = _lm_sp_step_fn(model, tx, aux_weight, data_axis, seq_axis,
-                                loss_chunk, health)
-
-    sharded = shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis), P()),
-        out_specs=(P(), P()),
-        check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    plan = Plan(engine="lm", layout="sp", sync="explicit",
+                data_axis=data_axis, seq_axis=seq_axis,
+                aux_weight=aux_weight, donate=donate, loss_chunk=loss_chunk,
+                health=health)
+    return _train(plan, mesh=mesh, model_ctor=model_ctor, tx=tx)
 
 
 def make_lm_sp_indexed_multi_train_step(model_ctor: Callable, tx, mesh: Mesh,
@@ -631,31 +551,11 @@ def make_lm_sp_indexed_multi_train_step(model_ctor: Callable, tx, mesh: Mesh,
     identical math to K sequential make_lm_sp_train_step calls (same
     per-step rng fold; parameter equality asserted to rtol 1e-5 in
     tests/test_lm_loop.py)."""
-    from tpu_dist.parallel.ring_attention import ring_attention_fn
-
-    model = model_ctor(attn_fn=ring_attention_fn(seq_axis))
-    n_seq = mesh.shape[seq_axis]
-    one_step = _lm_sp_step_fn(model, tx, aux_weight, data_axis, seq_axis,
-                              loss_chunk, health)
-
-    def per_device(state: TrainState, rows_all, idx, rng):
-        shard_len = (rows_all.shape[1] - 1) // n_seq
-        seq_idx = jax.lax.axis_index(seq_axis)
-
-        def body(st, idx_b):
-            rows = jnp.take(rows_all, idx_b, axis=0)
-            inputs, targets = _sp_window_slices(rows, seq_idx, shard_len)
-            return one_step(st, inputs, targets, rng)
-
-        state, metrics_k = jax.lax.scan(body, state, idx)
-        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
-
-    sharded = shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(), P(), P(None, data_axis), P()),
-        out_specs=(P(), P()),
-        check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    plan = Plan(engine="lm", layout="sp", sync="explicit", window="indexed",
+                steps_per_dispatch=2, data_axis=data_axis,
+                seq_axis=seq_axis, aux_weight=aux_weight, donate=donate,
+                loss_chunk=loss_chunk, health=health)
+    return _train(plan, mesh=mesh, model_ctor=model_ctor, tx=tx)
 
 
 def make_lm_sp_indexed_eval_step(model_ctor: Callable, mesh: Mesh,
@@ -666,34 +566,7 @@ def make_lm_sp_indexed_eval_step(model_ctor: Callable, mesh: Mesh,
     (params, rows_all (N, L+1) REPLICATED, idx (K, B) sharded (None, data),
     valid (K, B) f32 same sharding) -> metric sums over all K batches,
     sampler wrap-padding masked per row, psum'd over both axes."""
-    from tpu_dist.parallel.ring_attention import ring_attention_fn
-
-    model = model_ctor(attn_fn=ring_attention_fn(seq_axis))
-    n_seq = mesh.shape[seq_axis]
-
-    def per_device(params, rows_all, idx, valid):
-        shard_len = (rows_all.shape[1] - 1) // n_seq
-        seq_idx = jax.lax.axis_index(seq_axis)
-        pos_offset = seq_idx * shard_len
-
-        def body(sums, blk):
-            idx_b, valid_b = blk
-            rows = jnp.take(rows_all, idx_b, axis=0)
-            inputs, targets = _sp_window_slices(rows, seq_idx, shard_len)
-            mask = jnp.broadcast_to(valid_b[:, None], targets.shape).astype(
-                jnp.float32)
-            m = _lm_eval_metrics(model, params, inputs, targets, mask,
-                                 loss_chunk, pos_offset)
-            return jax.tree.map(jnp.add, sums, m), None
-
-        sums, _ = jax.lax.scan(body, zeros_lm_metrics(), (idx, valid))
-        return jax.tree.map(
-            lambda m: jax.lax.psum(jax.lax.psum(m, seq_axis), data_axis),
-            sums)
-
-    sharded = shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(), P(), P(None, data_axis), P(None, data_axis)),
-        out_specs=P(),
-        check_vma=False)
-    return jax.jit(sharded)
+    plan = Plan(engine="lm", layout="sp", sync="explicit", window="indexed",
+                steps_per_dispatch=2, data_axis=data_axis,
+                seq_axis=seq_axis, loss_chunk=loss_chunk)
+    return _eval(plan, mesh=mesh, model_ctor=model_ctor)

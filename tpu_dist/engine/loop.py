@@ -52,6 +52,11 @@ class Trainer:
     """
 
     def __init__(self, cfg: configs.TrainConfig, mesh=None):
+        # step plan (tpu_dist.plan): the `plan` knob rewrites the
+        # plan-owned config fields (incl. variant) and flips the
+        # trace-time kernel switches BEFORE anything below reads them
+        from tpu_dist.plan.compile import resolve_config_plan
+        cfg, self._plan_info = resolve_config_plan(cfg)
         self.cfg = cfg
         # fail fast on bad config, before device/model setup
         if cfg.resume and not os.path.exists(cfg.resume):
@@ -429,7 +434,8 @@ class Trainer:
         self._program_flops = None  # per-device step FLOPs (XLA cost model)
         # run observability: ledger + step tracer + skew monitor + hang
         # watchdog, wired from cfg (obs.RunObs); a pathless ledger is free
-        self.obs = RunObs("image", cfg, self.mesh, unit="img/s")
+        self.obs = RunObs("image", cfg, self.mesh, unit="img/s",
+                          plan_info=self._plan_info)
         # whether int8 matmuls (vit_* quant archs) route through the fused
         # Pallas kernel — trace-time static; stamped into step records so
         # ledger_report can attribute MFU deltas (LMTrainer twin)

@@ -7,6 +7,17 @@ XLA program: normalize/augment, forward, loss, grads, cross-replica reduction,
 optimizer update, and metric counts all fuse; there is no per-batch host
 round-trip and no barrier (XLA orders the collectives).
 
+Since round 15 this module holds the image engine's ONE step template
+(:func:`_train_step_fn` around the shared :func:`_apply_update` funnel) and
+the metric/loss helpers; every public ``make_*`` builder below is a THIN
+SHIM over the plan compiler (``tpu_dist.plan.compile``) — it names its
+variant as a declarative :class:`tpu_dist.plan.ir.Plan` and the compiler's
+validate/template/window/partition passes produce the callable. The
+builders' signatures and math are unchanged (loss/param parity is pinned
+bit-for-bit in tests/test_plan.py); what changed is that the jit/
+shard_map/windowed/bucketed/ring wrapper bodies now live once, in the
+compiler, instead of once per builder.
+
 Two interchangeable distribution flavors produce bit-comparable updates for
 BatchNorm-free models (for BN models the gradient math still agrees, but the
 running statistics differ by design — global-batch SyncBN vs per-replica +
@@ -33,18 +44,16 @@ the reference's equal-weight averaging of per-rank fractions
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from tpu_dist._compat import shard_map
+from jax.sharding import Mesh
 
 from tpu_dist.engine.state import TrainState
 from tpu_dist.ops import precision as prec
-from tpu_dist.parallel.collectives import compress_grads
 from tpu_dist.parallel.mesh import DATA_AXIS
+from tpu_dist.plan.ir import Plan
 
 
 def cross_entropy_sum(logits: jax.Array, labels: jax.Array,
@@ -158,7 +167,8 @@ def _apply_update(tx, state: TrainState, grads, new_stats, metrics,
 
 
 def _train_step_fn(model, tx, transform, health: str = "record") -> Callable:
-    """The pure (unjitted) train step shared by all wrappers."""
+    """The pure (unjitted) train step shared by all wrappers — THE image
+    engine step template the plan compiler lowers."""
 
     def step(state: TrainState, images_u8, labels, rng):
         dropout_rng, aug_rng = jax.random.split(jax.random.fold_in(rng, state.step))
@@ -173,48 +183,6 @@ def _train_step_fn(model, tx, transform, health: str = "record") -> Callable:
         return _apply_update(tx, state, grads, new_stats, metrics, health)
 
     return step
-
-
-def make_train_step(model, tx, transform, mesh: Mesh,
-                    data_axis: str = DATA_AXIS, donate: bool = True,
-                    health: str = "record") -> Callable:
-    """Compiler-partitioned step: jit over mesh, batch sharded, params replicated."""
-    repl = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, P(data_axis))
-    return jax.jit(_train_step_fn(model, tx, transform, health),
-                   in_shardings=(None, batch_sh, batch_sh, repl),
-                   out_shardings=(None, repl),
-                   donate_argnums=(0,) if donate else ())
-
-
-def make_multi_train_step(model, tx, transform, mesh: Mesh,
-                          data_axis: str = DATA_AXIS,
-                          donate: bool = True,
-                          health: str = "record") -> Callable:
-    """K optimizer steps in ONE dispatch: lax.scan over stacked batches.
-
-    signature: (state, images_u8 (K,B,...), labels (K,B), rng) -> (state,
-    metrics summed over the K steps). The TPU-idiomatic answer to dispatch
-    latency on a remote/high-latency controller link (the reference's analog
-    concern was CUDA-stream overlap, C13): the whole window executes on-device
-    with zero host round-trips. K is a trace-time constant (leading dim).
-    """
-    repl = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, P(None, data_axis))
-    step = _train_step_fn(model, tx, transform, health)
-
-    def multi(state: TrainState, images_u8, labels, rng):
-        def body(st, batch):
-            imgs, lbls = batch
-            st, metrics = step(st, imgs, lbls, rng)
-            return st, metrics
-        state, metrics_k = jax.lax.scan(body, state, (images_u8, labels))
-        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
-
-    return jax.jit(multi,
-                   in_shardings=(None, batch_sh, batch_sh, repl),
-                   out_shardings=(None, repl),
-                   donate_argnums=(0,) if donate else ())
 
 
 def pack_images_for_device(images_u8):
@@ -232,6 +200,49 @@ def pack_images_for_device(images_u8):
     if flat.shape[1] % 4 or not flat.flags.c_contiguous:
         return images_u8
     return flat.view(np.int32)
+
+
+# ---- the make_* builders: thin shims over the plan compiler ----------------
+# (the two hops below are plain `return f(...)` chains on purpose: distlint's
+# jit-factory fixpoint follows them, so `self.train_step = make_*(...)`
+# still derives the engine loops as hot)
+
+def _train(plan: Plan, **binds):
+    from tpu_dist.plan.compile import Bindings, compile_train_step
+    return compile_train_step(plan, Bindings(**binds))
+
+
+def _eval(plan: Plan, **binds):
+    from tpu_dist.plan.compile import Bindings, compile_eval_step
+    return compile_eval_step(plan, Bindings(**binds))
+
+
+def make_train_step(model, tx, transform, mesh: Mesh,
+                    data_axis: str = DATA_AXIS, donate: bool = True,
+                    health: str = "record") -> Callable:
+    """Compiler-partitioned step: jit over mesh, batch sharded, params replicated."""
+    plan = Plan(engine="image", data_axis=data_axis, donate=donate,
+                health=health)
+    return _train(plan, mesh=mesh, model=model, tx=tx,
+                     transform=transform)
+
+
+def make_multi_train_step(model, tx, transform, mesh: Mesh,
+                          data_axis: str = DATA_AXIS,
+                          donate: bool = True,
+                          health: str = "record") -> Callable:
+    """K optimizer steps in ONE dispatch: lax.scan over stacked batches.
+
+    signature: (state, images_u8 (K,B,...), labels (K,B), rng) -> (state,
+    metrics summed over the K steps). The TPU-idiomatic answer to dispatch
+    latency on a remote/high-latency controller link (the reference's analog
+    concern was CUDA-stream overlap, C13): the whole window executes on-device
+    with zero host round-trips. K is a trace-time constant (leading dim).
+    """
+    plan = Plan(engine="image", window="stacked", data_axis=data_axis,
+                donate=donate, health=health)
+    return _train(plan, mesh=mesh, model=model, tx=tx,
+                     transform=transform)
 
 
 def make_indexed_multi_train_step(model, tx, transform, mesh: Mesh,
@@ -254,26 +265,10 @@ def make_indexed_multi_train_step(model, tx, transform, mesh: Mesh,
     and lost, reference 4.apex_distributed2.py:80). Identical math to K
     sequential :func:`make_train_step` calls (same per-step rng fold).
     """
-    h, w, c = image_shape
-    repl = NamedSharding(mesh, P())
-    idx_sh = NamedSharding(mesh, P(None, data_axis))
-    step = _train_step_fn(model, tx, transform, health)
-
-    def multi(state: TrainState, images_all, labels_all, idx, rng):
-        def body(st, idx_b):
-            rows = jnp.take(images_all, idx_b, axis=0)
-            if rows.dtype == jnp.int32:  # packed: bitcast words back to bytes
-                rows = jax.lax.bitcast_convert_type(rows, jnp.uint8)
-            imgs = rows.reshape(-1, h, w, c)
-            lbls = jnp.take(labels_all, idx_b, axis=0)
-            return step(st, imgs, lbls, rng)
-        state, metrics_k = jax.lax.scan(body, state, idx)
-        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
-
-    return jax.jit(multi,
-                   in_shardings=(None, repl, repl, idx_sh, repl),
-                   out_shardings=(None, repl),
-                   donate_argnums=(0,) if donate else ())
+    plan = Plan(engine="image", window="indexed", data_axis=data_axis,
+                donate=donate, health=health)
+    return _train(plan, mesh=mesh, model=model, tx=tx,
+                     transform=transform, image_shape=image_shape)
 
 
 def make_indexed_eval_step(model, transform, mesh: Mesh, image_shape,
@@ -287,49 +282,18 @@ def make_indexed_eval_step(model, transform, mesh: Mesh, image_shape,
     is masked per sample via ``valid`` exactly like the host-fed
     :func:`make_eval_step`.
     """
-    h, w, c = image_shape
-    repl = NamedSharding(mesh, P())
-    idx_sh = NamedSharding(mesh, P(None, data_axis))
-
-    def step(params, batch_stats, images_all, labels_all, idx, valid):
-        def body(sums, blk):
-            idx_b, valid_b = blk
-            rows = jnp.take(images_all, idx_b, axis=0)
-            if rows.dtype == jnp.int32:
-                rows = jax.lax.bitcast_convert_type(rows, jnp.uint8)
-            x = transform(rows.reshape(-1, h, w, c), None)
-            labels = jnp.take(labels_all, idx_b, axis=0)
-            logits = model.apply({"params": params,
-                                  "batch_stats": batch_stats}, x, train=False)
-            m = _metric_sums(logits, labels,
-                             cross_entropy_sum(logits, labels, valid_b),
-                             valid_b)
-            return jax.tree.map(jnp.add, sums, m), None
-
-        zeros = {k: jnp.float32(0.0)
-                 for k in ("loss_sum", "correct1", "correct5", "count")}
-        sums, _ = jax.lax.scan(body, zeros, (idx, valid))
-        return sums
-
-    return jax.jit(step, in_shardings=(None, None, repl, repl, idx_sh, idx_sh),
-                   out_shardings=repl)
+    plan = Plan(engine="image", window="indexed", data_axis=data_axis)
+    return _eval(plan, mesh=mesh, model=model,
+                     eval_transform=transform,
+                     image_shape=image_shape)
 
 
 def make_eval_step(model, transform, mesh: Mesh,
                    data_axis: str = DATA_AXIS) -> Callable:
     """Distributed eval step (C15): metric sums on the global sharded batch."""
-    repl = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, P(data_axis))
-
-    def step(params, batch_stats, images_u8, labels, valid):
-        x = transform(images_u8, None)
-        logits = model.apply({"params": params, "batch_stats": batch_stats},
-                             x, train=False)
-        return _metric_sums(logits, labels,
-                            cross_entropy_sum(logits, labels, valid), valid)
-
-    return jax.jit(step, in_shardings=(None, None, batch_sh, batch_sh, batch_sh),
-                   out_shardings=repl)
+    plan = Plan(engine="image", data_axis=data_axis)
+    return _eval(plan, mesh=mesh, model=model,
+                     eval_transform=transform)
 
 
 def make_grad_accum_train_step(model, tx, transform, mesh: Mesh,
@@ -345,39 +309,12 @@ def make_grad_accum_train_step(model, tx, transform, mesh: Mesh,
     whose answer to batch 3200 was requiring 4x V100s). BN statistics advance
     per microbatch (same semantics as torch accumulation loops).
     """
-    repl = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, P(None, data_axis))
-
-    def step(state: TrainState, images_u8, labels, rng):
-        k = images_u8.shape[0]
-        dropout_rng, aug_rng = jax.random.split(jax.random.fold_in(rng, state.step))
-
-        def micro(carry, batch):
-            grads_acc, stats, i = carry
-            imgs, lbls = batch
-            d_rng = jax.random.fold_in(dropout_rng, i)
-            a_rng = jax.random.fold_in(aug_rng, i)
-            grad_fn = jax.value_and_grad(
-                lambda p: _loss_and_metrics(model, transform, p, stats,
-                                            imgs, lbls, d_rng, a_rng,
-                                            state.loss_scale, True),
-                has_aux=True)
-            (_, (new_stats, metrics)), grads = grad_fn(state.params)
-            grads_acc = jax.tree.map(lambda a, g: a + g / k, grads_acc, grads)
-            return (grads_acc, new_stats, i + 1), metrics
-
-        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
-                             state.params)
-        (grads, new_stats, _), metrics_k = jax.lax.scan(
-            micro, (zeros, state.batch_stats, jnp.int32(0)),
-            (images_u8, labels))
-        metrics = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
-        return _apply_update(tx, state, grads, new_stats, metrics, health)
-
-    return jax.jit(step,
-                   in_shardings=(None, batch_sh, batch_sh, repl),
-                   out_shardings=(None, repl),
-                   donate_argnums=(0,) if donate else ())
+    # the accum template reads K from the batch's leading dim at trace
+    # time; any grad_accum_steps > 1 selects it (2 = the mode marker)
+    plan = Plan(engine="image", grad_accum_steps=2, data_axis=data_axis,
+                donate=donate, health=health)
+    return _train(plan, mesh=mesh, model=model, tx=tx,
+                     transform=transform)
 
 
 def make_shard_map_train_step(model, tx, transform, mesh: Mesh,
@@ -409,55 +346,12 @@ def make_shard_map_train_step(model, tx, transform, mesh: Mesh,
     across it per data shard, and the grads of the (replicated) params are
     additionally pmean'd over it.
     """
-    repl = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, P(data_axis))
-    nrep = mesh.shape[data_axis]
-    if adasum and grad_bucket_mb > 0:
-        raise ValueError("grad_bucket_mb decomposes the mean allreduce; "
-                         "adasum replaces it — the two are exclusive")
-
-    def per_device(state: TrainState, images_u8, labels, rng):
-        dropout_rng, aug_rng = jax.random.split(
-            jax.random.fold_in(jax.random.fold_in(rng, state.step),
-                               jax.lax.axis_index(data_axis)))
-        grad_fn = jax.value_and_grad(
-            lambda p: _loss_and_metrics(model, transform, p, state.batch_stats,
-                                        images_u8, labels, dropout_rng, aug_rng,
-                                        state.loss_scale, True),
-            has_aux=True)
-        (_, (new_stats, metrics)), grads = grad_fn(state.params)
-        if model_axis is not None:
-            # ring TP: params are replicated over the model axis while the
-            # per-device losses are identical across it — the mean restores
-            # the single-loss gradient (overlap.py scaling note)
-            grads = jax.tree.map(
-                lambda g: jax.lax.pmean(g, model_axis), grads)
-        if adasum:
-            from tpu_dist.parallel.collectives import adasum_reduce
-            grads = adasum_reduce(grads, data_axis, nrep)
-        else:
-            # horovod allreduce: predivide -> (compress) -> psum -> postdivide
-            pre = predivide_factor if predivide_factor != 1.0 else nrep
-            grads = jax.tree.map(lambda g: g / pre, grads)
-            down, up = compress_grads(grads, grad_compression)
-            if grad_bucket_mb > 0:
-                from tpu_dist.parallel.overlap import bucketed_grad_sync
-                down = bucketed_grad_sync(down, data_axis, grad_bucket_mb,
-                                          mean=False, axis_size=nrep)
-            else:
-                down = jax.tree.map(lambda g: jax.lax.psum(g, data_axis), down)
-            grads = up(down)
-            if predivide_factor != 1.0:
-                grads = jax.tree.map(lambda g: g * (predivide_factor / nrep),
-                                     grads)
-        # per-replica BN stats -> pmean (≈ horovod local BN + periodic sync)
-        new_stats = jax.tree.map(lambda s: jax.lax.pmean(s, data_axis), new_stats)
-        metrics = jax.tree.map(lambda m: jax.lax.psum(m, data_axis), metrics)
-        return _apply_update(tx, state, grads, new_stats, metrics, health)
-
-    sharded = shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(), P(data_axis), P(data_axis), P()),
-        out_specs=(P(), P()),
-        check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    plan = Plan(engine="image", sync="explicit",
+                layout="tp" if model_axis is not None else "dp",
+                tp_impl="ring" if model_axis is not None else "gspmd",
+                model_axis=model_axis or "model",
+                data_axis=data_axis, grad_compression=grad_compression,
+                predivide_factor=predivide_factor, adasum=adasum,
+                grad_bucket_mb=grad_bucket_mb, donate=donate, health=health)
+    return _train(plan, mesh=mesh, model=model, tx=tx,
+                     transform=transform)

@@ -84,12 +84,18 @@ class RunObs:
     throughput unit of this run's step records ("img/s" | "tok/s").
     """
 
-    def __init__(self, kind: str, cfg, mesh=None, unit: str = "items/s"):
+    def __init__(self, kind: str, cfg, mesh=None, unit: str = "items/s",
+                 plan_info=None):
         import jax
 
         self.kind = kind
         self.cfg = cfg
         self.unit = unit
+        # resolved step plan (tpu_dist.plan): {'source', 'hash', 'knobs',
+        # 'device_kind'} from plan.compile.resolve_config_plan — stamped
+        # into run_start and emitted as its own 'plan' event so reports
+        # and the tuner's measured-refinement loop can key runs by plan
+        self.plan_info = plan_info
         pidx = jax.process_index()
         self.is_main = pidx == 0
         # run lineage (obs.goodput): one logical job = N restart attempts,
@@ -263,7 +269,18 @@ class RunObs:
             # elastic lineage (parallel.consensus): reports tell a
             # degraded layout and its rendezvous epoch from the planned one
             degraded=os.environ.get("TPU_DIST_DEGRADED") == "1",
-            mesh_epoch=mesh_epoch)
+            mesh_epoch=mesh_epoch,
+            # step-plan identity (tpu_dist.plan): which tuned plan drove
+            # this run's step compilation (None = hand-set knobs)
+            plan_hash=(self.plan_info or {}).get("hash"),
+            plan_source=(self.plan_info or {}).get("source"),
+            plan_knobs=(self.plan_info or {}).get("knobs"))
+        if self.plan_info:
+            self.ledger.emit(
+                "plan", source=self.plan_info.get("source"),
+                plan_hash=self.plan_info.get("hash"),
+                knobs=self.plan_info.get("knobs"),
+                device_kind=self.plan_info.get("device_kind"))
         self._arm_crash_guard()
 
     def run_end(self, status: Optional[str] = None, **extra) -> None:

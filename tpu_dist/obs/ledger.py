@@ -131,6 +131,16 @@ EVENT_SCHEMA = {
     # cumulative fleet-wide breach count. Feeds the
     # tpu_dist_fleet_* Prometheus series through the metrics sink
     "fleet": ("hosts_live", "goodput_ratio", "slo_breaches"),
+    # resolved step plan (tpu_dist.plan): which tuned/loaded plan drove
+    # this run's step compilation — source names the file|'auto', plan_hash
+    # the content address (plan.ir.plan_hash), knobs the non-default knob
+    # diff; device_kind rides as a field so a report can say which table
+    # row the plan was selected for. Emitted once, right after run_start
+    "plan": ("source", "plan_hash", "knobs"),
+    # one auto-tuner invocation (plan.tune via tools/tune.py --ledger):
+    # the search's identity — candidate count and the winning plan hash
+    # per device kind; workload/measured extras ride along
+    "tune": ("device_kind", "candidates", "best_hash"),
     # run rollup: total steps, wall seconds, best metric in extras;
     # status ("ok"|"crashed"|"interrupted") rides as an extra stamped by
     # RunObs.run_end — the crash-safe shutdown path sets "crashed"

@@ -41,7 +41,17 @@ from jax.experimental.pallas import tpu as pltpu
 from tpu_dist.ops.pallas_sgd import clip_scale
 
 LANE = 128
-BLOCK_ROWS = 512    # 512x128 fp32 = 256 KiB per VMEM buffer
+BLOCK_ROWS = 512    # default: 512x128 fp32 = 256 KiB per VMEM buffer
+
+# searchable block size (plan IR, round 15) — ONE setting shared with
+# ops.pallas_sgd so the plan's opt_block_rows drives both fused kernels;
+# the authority (setter, env seed, validation) lives there
+from tpu_dist.ops import pallas_sgd as _psgd
+
+
+def set_block_rows(rows=None) -> None:
+    """Alias of ops.pallas_sgd.set_block_rows (one shared setting)."""
+    _psgd.set_block_rows(rows)
 
 
 def _adamw_kernel(scal_ref, p_ref, g_ref, m_ref, v_ref, p_out, m_out, v_out):
@@ -65,8 +75,8 @@ def _adamw_kernel(scal_ref, p_ref, g_ref, m_ref, v_ref, p_out, m_out, v_out):
 
 def _fused_adamw_2d(p2, g2, m2, v2, scalars, interpret: bool):
     rows = p2.shape[0]
-    grid = (pl.cdiv(rows, BLOCK_ROWS),)
-    bs = lambda: pl.BlockSpec((BLOCK_ROWS, LANE), lambda i: (i, 0),
+    grid = (pl.cdiv(rows, _psgd.block_rows()),)
+    bs = lambda: pl.BlockSpec((_psgd.block_rows(), LANE), lambda i: (i, 0),
                               memory_space=pl.ANY if interpret else pltpu.VMEM)
     return pl.pallas_call(
         _adamw_kernel,

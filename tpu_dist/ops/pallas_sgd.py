@@ -39,7 +39,38 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128          # VPU lane width
-BLOCK_ROWS = 512    # rows per grid step: 512x128 fp32 = 256 KiB/buffer in VMEM
+BLOCK_ROWS = 512    # default rows per grid step: 512x128 fp32 = 256 KiB/buffer
+
+# searchable block size (plan IR, round 15): the 512-row tile was
+# hard-coded through round 14; the plan auto-tuner threads it now.
+# Trace-time static — set before building step functions
+# (plan.compile.activate_plan does). Env seed for bench/CLI runs.
+import os as _os
+
+_BLOCK_ROWS = BLOCK_ROWS
+
+
+def set_block_rows(rows=None) -> None:
+    """Set the fused-optimizer kernels' VMEM tile rows (None restores the
+    512 default; shared setting with ops.pallas_adamw). Legality lives in
+    plan.ir.validate_opt_block_rows — the ONE rule the IR also enforces."""
+    from tpu_dist.plan.ir import validate_opt_block_rows
+
+    global _BLOCK_ROWS
+    rows = BLOCK_ROWS if rows is None else int(rows)
+    validate_opt_block_rows(rows)
+    _BLOCK_ROWS = rows
+
+
+if _os.environ.get("TPU_DIST_OPT_BLOCK_ROWS"):
+    # the env seed rides the validated setter: a bad value fails loudly
+    # at import, not as a Mosaic tiling abort at first trace
+    set_block_rows(int(_os.environ["TPU_DIST_OPT_BLOCK_ROWS"]))
+
+
+def block_rows() -> int:
+    """The row-tile size the next trace will use."""
+    return _BLOCK_ROWS
 
 
 def clip_scale(grads, clip_norm: float):
@@ -73,8 +104,8 @@ def _sgd_kernel(scal_ref, p_ref, g_ref, m_ref, p_out, m_out):
 
 def _fused_sgd_2d(p2, g2, m2, scalars, interpret: bool):
     rows = p2.shape[0]
-    grid = (pl.cdiv(rows, BLOCK_ROWS),)
-    bs = lambda: pl.BlockSpec((BLOCK_ROWS, LANE), lambda i: (i, 0),
+    grid = (pl.cdiv(rows, _BLOCK_ROWS),)
+    bs = lambda: pl.BlockSpec((_BLOCK_ROWS, LANE), lambda i: (i, 0),
                               memory_space=pl.ANY if interpret else pltpu.VMEM)
     return pl.pallas_call(
         _sgd_kernel,

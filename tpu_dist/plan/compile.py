@@ -1,0 +1,781 @@
+"""Plan compiler: lower a :class:`tpu_dist.plan.ir.Plan` to step callables.
+
+ONE pass pipeline replaces the hand-built step-builder matrix (PR 15):
+
+1. **validate** — :meth:`Plan.validate` + the mesh-axis check (the same
+   exclusion rules the engines enforced ad hoc);
+2. **template** — pick the engine's pure step function (the ONE step
+   template per engine: ``engine/steps.py:_train_step_fn`` for images,
+   ``engine/lm_steps.py:_lm_step_fn`` and its explicit/ring/sp per-device
+   flavors for tokens — the templates stay in the engine modules, the
+   compiler composes them);
+3. **window** — optionally wrap the template in a ``lax.scan`` dispatch
+   window (host-fed stacked batches, or HBM-resident indexed gathers with
+   the engine's gather prelude);
+4. **partition** — ``jit`` with GSPMD shardings (``sync='gspmd'``) or
+   ``shard_map`` + ``jit`` with explicit specs (``sync='explicit'`` /
+   ``layout='sp'``).
+
+The legacy ``make_*`` builders in ``engine/steps.py`` and
+``engine/lm_steps.py`` are now thin shims over :func:`compile_plan`
+(loss/param parity pinned bit-for-bit in tests/test_plan.py): every
+wrapper body that used to live in a ``make_*`` lives HERE, once.
+
+``activate_plan`` applies a plan's global trace-time switches (fused
+int8 kernel, Pallas block sizes) and ``resolve_config_plan`` implements
+the configs' ``plan: auto|<path>|none`` knob for both engines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_dist._compat import shard_map
+from tpu_dist.engine.state import TrainState
+from tpu_dist.plan.ir import (Plan, PlanError, apply_plan_to_config,
+                              plan_hash, plan_knob_summary)
+
+
+@dataclass
+class Bindings:
+    """What a plan lowers AGAINST: the run's concrete objects. The model
+    binding must already embody the plan's quant/tp_impl (flax modules
+    bake those in at construction — the engines build them from the same
+    config the plan was applied to)."""
+
+    mesh: Mesh
+    model: Any = None                 # flax module (non-sp paths)
+    model_ctor: Optional[Callable] = None  # sp: ctor(attn_fn=...) -> model
+    tx: Any = None                    # optimizer (optax or fused protocol)
+    transform: Optional[Callable] = None       # image train transform
+    eval_transform: Optional[Callable] = None  # image eval transform
+    image_shape: Optional[Tuple[int, int, int]] = None  # indexed image paths
+    explicit_step_fn: Optional[Callable] = None  # pre-built per-device step
+    #                                    (the lm explicit window wrapper)
+
+
+class CompiledPlan:
+    """Lazy pair of compiled callables for one (plan, bindings):
+    ``train_step`` and ``eval_step`` lower on first access (a maker shim
+    that only needs one never builds the other)."""
+
+    def __init__(self, plan: Plan, binds: Bindings):
+        _pass_validate(plan, binds)
+        self.plan = plan
+        self.binds = binds
+        self._train = None
+        self._eval = None
+
+    @property
+    def train_step(self) -> Callable:
+        if self._train is None:
+            self._train = _lower_train(self.plan, self.binds)
+        return self._train
+
+    @property
+    def eval_step(self) -> Callable:
+        if self._eval is None:
+            self._eval = _lower_eval(self.plan, self.binds)
+        return self._eval
+
+
+def compile_plan(plan: Plan, binds: Bindings) -> CompiledPlan:
+    """THE entry point: validate + return the lazy compiled pair."""
+    return CompiledPlan(plan, binds)
+
+
+def compile_train_step(plan: Plan, binds: Bindings) -> Callable:
+    """Validate + lower the train step directly (the make_* shim entry:
+    a plain `return compile_train_step(...)` chain keeps the builders
+    inside distlint's jit-factory fixpoint, so the engines' loops still
+    derive as hot — an attribute hop through CompiledPlan would not)."""
+    _pass_validate(plan, binds)
+    return _lower_train(plan, binds)
+
+
+def compile_eval_step(plan: Plan, binds: Bindings) -> Callable:
+    """Validate + lower the eval step directly (compile_train_step's
+    forward-only twin)."""
+    _pass_validate(plan, binds)
+    return _lower_eval(plan, binds)
+
+
+# ---- pass 1: validate -----------------------------------------------------
+
+def _pass_validate(plan: Plan, binds: Bindings) -> None:
+    plan.validate()
+    if binds.mesh is None:
+        raise PlanError("Bindings.mesh is required")
+    plan.validate_against_mesh(dict(binds.mesh.shape))
+    if plan.layout == "sp" and binds.model_ctor is None:
+        raise PlanError("layout='sp' lowers a model_ctor(attn_fn=...) — "
+                        "the ring attention binds per seq axis")
+    if plan.engine == "image" and binds.model is not None \
+            and binds.transform is None and binds.tx is not None:
+        raise PlanError("the image templates need a transform binding")
+
+
+# ---- pass 4 helpers: partition --------------------------------------------
+
+def _jit_gspmd(fn, in_shardings, out_shardings, donate: bool):
+    return jax.jit(fn, in_shardings=in_shardings,
+                   out_shardings=out_shardings,
+                   donate_argnums=(0,) if donate else ())
+
+
+def _shard_map_jit(fn, mesh, in_specs, out_specs, donate: bool):
+    sharded = shard_map(fn, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+# ---- image lowerings ------------------------------------------------------
+
+def _image_accum_train(plan: Plan, b: Bindings) -> Callable:
+    """ONE optimizer step from K microbatches (the grad-accum template;
+    the steps.py make_grad_accum_train_step body, verbatim)."""
+    from tpu_dist.engine.steps import _apply_update, _loss_and_metrics
+
+    mesh, model, tx, transform = b.mesh, b.model, b.tx, b.transform
+    health = plan.health
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(None, plan.data_axis))
+
+    def step(state: TrainState, images_u8, labels, rng):
+        k = images_u8.shape[0]
+        dropout_rng, aug_rng = jax.random.split(
+            jax.random.fold_in(rng, state.step))
+
+        def micro(carry, batch):
+            grads_acc, stats, i = carry
+            imgs, lbls = batch
+            d_rng = jax.random.fold_in(dropout_rng, i)
+            a_rng = jax.random.fold_in(aug_rng, i)
+            grad_fn = jax.value_and_grad(
+                lambda p: _loss_and_metrics(model, transform, p, stats,
+                                            imgs, lbls, d_rng, a_rng,
+                                            state.loss_scale, True),
+                has_aux=True)
+            (_, (new_stats, metrics)), grads = grad_fn(state.params)
+            grads_acc = jax.tree.map(lambda a, g: a + g / k, grads_acc,
+                                     grads)
+            return (grads_acc, new_stats, i + 1), metrics
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             state.params)
+        (grads, new_stats, _), metrics_k = jax.lax.scan(
+            micro, (zeros, state.batch_stats, jnp.int32(0)),
+            (images_u8, labels))
+        metrics = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
+        return _apply_update(tx, state, grads, new_stats, metrics, health)
+
+    return _jit_gspmd(step, (None, batch_sh, batch_sh, repl), (None, repl),
+                      plan.donate)
+
+
+def _image_explicit_train(plan: Plan, b: Bindings) -> Callable:
+    """Explicit-collective image step (the make_shard_map_train_step
+    per-device body, verbatim): horovod allreduce with predivide /
+    compression / Adasum / DDP bucket decomposition / ring-TP pmean."""
+    from tpu_dist.engine.steps import _apply_update, _loss_and_metrics
+    from tpu_dist.parallel.collectives import compress_grads
+
+    mesh, model, tx, transform = b.mesh, b.model, b.tx, b.transform
+    data_axis = plan.data_axis
+    health = plan.health
+    grad_compression = plan.grad_compression
+    predivide_factor = plan.predivide_factor
+    adasum = plan.adasum
+    grad_bucket_mb = plan.grad_bucket_mb
+    model_axis = plan.model_axis if plan.tp_impl == "ring" else None
+    nrep = mesh.shape[data_axis]
+
+    def per_device(state: TrainState, images_u8, labels, rng):
+        dropout_rng, aug_rng = jax.random.split(
+            jax.random.fold_in(jax.random.fold_in(rng, state.step),
+                               jax.lax.axis_index(data_axis)))
+        grad_fn = jax.value_and_grad(
+            lambda p: _loss_and_metrics(model, transform, p,
+                                        state.batch_stats, images_u8,
+                                        labels, dropout_rng, aug_rng,
+                                        state.loss_scale, True),
+            has_aux=True)
+        (_, (new_stats, metrics)), grads = grad_fn(state.params)
+        if model_axis is not None:
+            # ring TP: params are replicated over the model axis while the
+            # per-device losses are identical across it — the mean restores
+            # the single-loss gradient (overlap.py scaling note)
+            grads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, model_axis), grads)
+        if adasum:
+            from tpu_dist.parallel.collectives import adasum_reduce
+            grads = adasum_reduce(grads, data_axis, nrep)
+        else:
+            # horovod allreduce: predivide -> (compress) -> psum -> postdivide
+            pre = predivide_factor if predivide_factor != 1.0 else nrep
+            grads = jax.tree.map(lambda g: g / pre, grads)
+            down, up = compress_grads(grads, grad_compression)
+            if grad_bucket_mb > 0:
+                from tpu_dist.parallel.overlap import bucketed_grad_sync
+                down = bucketed_grad_sync(down, data_axis, grad_bucket_mb,
+                                          mean=False, axis_size=nrep)
+            else:
+                down = jax.tree.map(lambda g: jax.lax.psum(g, data_axis),
+                                    down)
+            grads = up(down)
+            if predivide_factor != 1.0:
+                grads = jax.tree.map(lambda g: g * (predivide_factor / nrep),
+                                     grads)
+        # per-replica BN stats -> pmean (≈ horovod local BN + periodic sync)
+        new_stats = jax.tree.map(lambda s: jax.lax.pmean(s, data_axis),
+                                 new_stats)
+        metrics = jax.tree.map(lambda m: jax.lax.psum(m, data_axis), metrics)
+        return _apply_update(tx, state, grads, new_stats, metrics, health)
+
+    return _shard_map_jit(per_device, mesh,
+                          (P(), P(data_axis), P(data_axis), P()),
+                          (P(), P()), plan.donate)
+
+
+def _image_train(plan: Plan, b: Bindings) -> Callable:
+    """The gspmd image train lowerings: plain jit, stacked K-step window,
+    or HBM-resident indexed window around ONE template
+    (engine.steps._train_step_fn)."""
+    from tpu_dist.engine.steps import _train_step_fn
+
+    mesh = b.mesh
+    data_axis = plan.data_axis
+    repl = NamedSharding(mesh, P())
+    step = _train_step_fn(b.model, b.tx, b.transform, plan.health)
+
+    if plan.window == "none":
+        batch_sh = NamedSharding(mesh, P(data_axis))
+        return _jit_gspmd(step, (None, batch_sh, batch_sh, repl),
+                          (None, repl), plan.donate)
+
+    if plan.window == "stacked":
+        batch_sh = NamedSharding(mesh, P(None, data_axis))
+
+        def multi(state: TrainState, images_u8, labels, rng):
+            def body(st, batch):
+                imgs, lbls = batch
+                st, metrics = step(st, imgs, lbls, rng)
+                return st, metrics
+            state, metrics_k = jax.lax.scan(body, state,
+                                            (images_u8, labels))
+            return state, jax.tree.map(lambda m: jnp.sum(m, axis=0),
+                                       metrics_k)
+
+        return _jit_gspmd(multi, (None, batch_sh, batch_sh, repl),
+                          (None, repl), plan.donate)
+
+    # window == "indexed": device-resident dataset, (K, B) index windows
+    if b.image_shape is None:
+        raise PlanError("the image indexed window needs an image_shape "
+                        "binding")
+    h, w, c = b.image_shape
+    idx_sh = NamedSharding(mesh, P(None, data_axis))
+
+    def multi(state: TrainState, images_all, labels_all, idx, rng):
+        def body(st, idx_b):
+            rows = jnp.take(images_all, idx_b, axis=0)
+            if rows.dtype == jnp.int32:  # packed: bitcast words back to bytes
+                rows = jax.lax.bitcast_convert_type(rows, jnp.uint8)
+            imgs = rows.reshape(-1, h, w, c)
+            lbls = jnp.take(labels_all, idx_b, axis=0)
+            return step(st, imgs, lbls, rng)
+        state, metrics_k = jax.lax.scan(body, state, idx)
+        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
+
+    return _jit_gspmd(multi, (None, repl, repl, idx_sh, repl), (None, repl),
+                      plan.donate)
+
+
+def _image_eval(plan: Plan, b: Bindings) -> Callable:
+    """Image eval lowerings: per-batch metric sums, or the whole-val-set
+    indexed scan (engine.steps make_eval_step / make_indexed_eval_step
+    bodies, verbatim)."""
+    from tpu_dist.engine.steps import _metric_sums, cross_entropy_sum
+
+    mesh = b.mesh
+    model = b.model
+    transform = b.eval_transform or b.transform
+    data_axis = plan.data_axis
+    repl = NamedSharding(mesh, P())
+
+    if plan.window != "indexed":
+        batch_sh = NamedSharding(mesh, P(data_axis))
+
+        def step(params, batch_stats, images_u8, labels, valid):
+            x = transform(images_u8, None)
+            logits = model.apply({"params": params,
+                                  "batch_stats": batch_stats}, x,
+                                 train=False)
+            return _metric_sums(logits, labels,
+                                cross_entropy_sum(logits, labels, valid),
+                                valid)
+
+        return jax.jit(step, in_shardings=(None, None, batch_sh, batch_sh,
+                                           batch_sh),
+                       out_shardings=repl)
+
+    if b.image_shape is None:
+        raise PlanError("the image indexed eval needs an image_shape "
+                        "binding")
+    h, w, c = b.image_shape
+    idx_sh = NamedSharding(mesh, P(None, data_axis))
+
+    def step(params, batch_stats, images_all, labels_all, idx, valid):
+        def body(sums, blk):
+            idx_b, valid_b = blk
+            rows = jnp.take(images_all, idx_b, axis=0)
+            if rows.dtype == jnp.int32:
+                rows = jax.lax.bitcast_convert_type(rows, jnp.uint8)
+            x = transform(rows.reshape(-1, h, w, c), None)
+            labels = jnp.take(labels_all, idx_b, axis=0)
+            logits = model.apply({"params": params,
+                                  "batch_stats": batch_stats}, x,
+                                 train=False)
+            m = _metric_sums(logits, labels,
+                             cross_entropy_sum(logits, labels, valid_b),
+                             valid_b)
+            return jax.tree.map(jnp.add, sums, m), None
+
+        zeros = {k: jnp.float32(0.0)
+                 for k in ("loss_sum", "correct1", "correct5", "count")}
+        sums, _ = jax.lax.scan(body, zeros, (idx, valid))
+        return sums
+
+    return jax.jit(step, in_shardings=(None, None, repl, repl, idx_sh,
+                                       idx_sh),
+                   out_shardings=repl)
+
+
+# ---- lm lowerings ---------------------------------------------------------
+
+def _lm_accum_train(plan: Plan, b: Bindings) -> Callable:
+    """LM grad-accum step (make_lm_grad_accum_train_step body)."""
+    from tpu_dist.engine.lm_steps import _lm_grads_and_metrics
+    from tpu_dist.engine.steps import _apply_update
+
+    mesh, model, tx = b.mesh, b.model, b.tx
+    aux_weight, loss_chunk, health = (plan.aux_weight, plan.loss_chunk,
+                                      plan.health)
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(None, plan.data_axis))
+
+    def step(state: TrainState, inputs, targets, rng):
+        k = inputs.shape[0]
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def micro(carry, batch):
+            grads_acc, i = carry
+            mb_in, mb_tg = batch
+            grads, metrics = _lm_grads_and_metrics(
+                model, aux_weight, state.params, mb_in, mb_tg,
+                jax.random.fold_in(dropout_rng, i), loss_chunk)
+            grads_acc = jax.tree.map(lambda a, g: a + g / k, grads_acc,
+                                     grads)
+            return (grads_acc, i + 1), metrics
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             state.params)
+        (grads, _), metrics_k = jax.lax.scan(
+            micro, (zeros, jnp.int32(0)), (inputs, targets))
+        metrics = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
+        return _apply_update(tx, state, grads, {}, metrics, health)
+
+    return _jit_gspmd(step, (None, batch_sh, batch_sh, repl), (None, repl),
+                      plan.donate)
+
+
+def _lm_explicit_template(plan: Plan, b: Bindings) -> Callable:
+    """The explicit per-device LM step the plan names: a pre-built
+    ``explicit_step_fn`` binding wins (the engines build ring/bucketed
+    flavors once and window them); otherwise ring or bucketed-dp from the
+    engine templates."""
+    if b.explicit_step_fn is not None:
+        return b.explicit_step_fn
+    from tpu_dist.engine.lm_steps import (_lm_explicit_dp_step_fn,
+                                          _lm_tp_ring_step_fn)
+
+    if plan.tp_impl == "ring":
+        return _lm_tp_ring_step_fn(
+            b.model, b.tx, plan.aux_weight, plan.data_axis,
+            plan.model_axis, b.mesh.shape[plan.model_axis],
+            plan.loss_chunk, plan.health)
+    return _lm_explicit_dp_step_fn(
+        b.model, b.tx, plan.aux_weight, plan.data_axis,
+        b.mesh.shape[plan.data_axis], plan.grad_bucket_mb,
+        plan.loss_chunk, plan.health)
+
+
+def _lm_explicit_train(plan: Plan, b: Bindings) -> Callable:
+    """Partition an explicit per-device LM step: single-batch shard_map
+    (the _wrap_explicit_step body) or the indexed scan-inside-shard_map
+    window (make_lm_explicit_indexed_multi_train_step body)."""
+    step_fn = _lm_explicit_template(plan, b)
+    mesh = b.mesh
+    data_axis = plan.data_axis
+
+    if plan.window == "none":
+        return _shard_map_jit(step_fn, mesh,
+                              (P(), P(data_axis), P(data_axis), P()),
+                              (P(), P()), plan.donate)
+
+    def per_device(state: TrainState, rows_all, idx, rng):
+        def body(st, idx_b):
+            rows = jnp.take(rows_all, idx_b, axis=0)     # (B_local, L+1)
+            return step_fn(st, rows[:, :-1], rows[:, 1:], rng)
+        state, metrics_k = jax.lax.scan(body, state, idx)
+        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
+
+    return _shard_map_jit(per_device, mesh,
+                          (P(), P(), P(None, data_axis), P()),
+                          (P(), P()), plan.donate)
+
+
+def _lm_sp_train(plan: Plan, b: Bindings) -> Callable:
+    """Sequence-parallel LM lowerings (ring attention inside shard_map):
+    single-batch or the indexed device-side-shift window
+    (make_lm_sp_train_step / make_lm_sp_indexed_multi_train_step bodies)."""
+    from tpu_dist.engine.lm_steps import _lm_sp_step_fn, _sp_window_slices
+    from tpu_dist.parallel.ring_attention import ring_attention_fn
+
+    mesh = b.mesh
+    data_axis, seq_axis = plan.data_axis, plan.seq_axis
+    model = b.model_ctor(attn_fn=ring_attention_fn(seq_axis))
+    one_step = _lm_sp_step_fn(model, b.tx, plan.aux_weight, data_axis,
+                              seq_axis, plan.loss_chunk, plan.health)
+
+    if plan.window == "none":
+        return _shard_map_jit(
+            one_step, mesh,
+            (P(), P(data_axis, seq_axis), P(data_axis, seq_axis), P()),
+            (P(), P()), plan.donate)
+
+    n_seq = mesh.shape[seq_axis]
+
+    def per_device(state: TrainState, rows_all, idx, rng):
+        shard_len = (rows_all.shape[1] - 1) // n_seq
+        seq_idx = jax.lax.axis_index(seq_axis)
+
+        def body(st, idx_b):
+            rows = jnp.take(rows_all, idx_b, axis=0)
+            inputs, targets = _sp_window_slices(rows, seq_idx, shard_len)
+            return one_step(st, inputs, targets, rng)
+
+        state, metrics_k = jax.lax.scan(body, state, idx)
+        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
+
+    return _shard_map_jit(per_device, mesh,
+                          (P(), P(), P(None, data_axis), P()),
+                          (P(), P()), plan.donate)
+
+
+def _lm_train(plan: Plan, b: Bindings) -> Callable:
+    """The gspmd LM train lowerings: plain jit (dp and every GSPMD-placed
+    layout) or the HBM-resident indexed window, around the ONE template
+    (engine.lm_steps._lm_step_fn)."""
+    from tpu_dist.engine.lm_steps import _lm_step_fn
+
+    mesh = b.mesh
+    data_axis = plan.data_axis
+    repl = NamedSharding(mesh, P())
+    one_step = _lm_step_fn(b.model, b.tx, plan.aux_weight, plan.loss_chunk,
+                           plan.health)
+
+    if plan.window == "none":
+        batch_sh = NamedSharding(mesh, P(data_axis))
+        # With TP the state arrives pre-sharded (parallel.tp
+        # shard_lm_params) and in_shardings=None lets GSPMD propagate that
+        # layout through the step; pure DP states arrive replicated — the
+        # same jit serves both. out_shardings=None likewise.
+        return jax.jit(one_step,
+                       in_shardings=(None, batch_sh, batch_sh, repl),
+                       out_shardings=None,
+                       donate_argnums=(0,) if plan.donate else ())
+
+    idx_sh = NamedSharding(mesh, P(None, data_axis))
+
+    def multi(state: TrainState, rows_all, idx, rng):
+        def body(st, idx_b):
+            rows = jnp.take(rows_all, idx_b, axis=0)     # (B, L+1)
+            return one_step(st, rows[:, :-1], rows[:, 1:], rng)
+        state, metrics_k = jax.lax.scan(body, state, idx)
+        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics_k)
+
+    return _jit_gspmd(multi, (None, repl, idx_sh, repl), (None, repl),
+                      plan.donate)
+
+
+def _lm_sp_eval(plan: Plan, b: Bindings) -> Callable:
+    """SP eval lowerings (make_lm_sp_eval_step /
+    make_lm_sp_indexed_eval_step bodies)."""
+    from tpu_dist.engine.lm_steps import (_lm_eval_metrics,
+                                          _sp_window_slices,
+                                          zeros_lm_metrics)
+    from tpu_dist.parallel.ring_attention import ring_attention_fn
+
+    mesh = b.mesh
+    data_axis, seq_axis = plan.data_axis, plan.seq_axis
+    loss_chunk = plan.loss_chunk
+    model = b.model_ctor(attn_fn=ring_attention_fn(seq_axis))
+
+    if plan.window != "indexed":
+        def per_device(params, inputs, targets, valid):
+            seq_idx = jax.lax.axis_index(seq_axis)
+            pos_offset = seq_idx * inputs.shape[1]
+            mask = jnp.broadcast_to(valid[:, None], targets.shape).astype(
+                jnp.float32)
+            metrics = _lm_eval_metrics(model, params, inputs, targets,
+                                       mask, loss_chunk, pos_offset)
+            return jax.tree.map(
+                lambda m: jax.lax.psum(jax.lax.psum(m, seq_axis),
+                                       data_axis), metrics)
+
+        sharded = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis),
+                      P(data_axis)),
+            out_specs=P(), check_vma=False)
+        return jax.jit(sharded)
+
+    n_seq = mesh.shape[seq_axis]
+
+    def per_device(params, rows_all, idx, valid):
+        shard_len = (rows_all.shape[1] - 1) // n_seq
+        seq_idx = jax.lax.axis_index(seq_axis)
+        pos_offset = seq_idx * shard_len
+
+        def body(sums, blk):
+            idx_b, valid_b = blk
+            rows = jnp.take(rows_all, idx_b, axis=0)
+            inputs, targets = _sp_window_slices(rows, seq_idx, shard_len)
+            mask = jnp.broadcast_to(valid_b[:, None], targets.shape).astype(
+                jnp.float32)
+            m = _lm_eval_metrics(model, params, inputs, targets, mask,
+                                 loss_chunk, pos_offset)
+            return jax.tree.map(jnp.add, sums, m), None
+
+        sums, _ = jax.lax.scan(body, zeros_lm_metrics(), (idx, valid))
+        return jax.tree.map(
+            lambda m: jax.lax.psum(jax.lax.psum(m, seq_axis), data_axis),
+            sums)
+
+    sharded = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P(), P(None, data_axis), P(None, data_axis)),
+        out_specs=P(), check_vma=False)
+    return jax.jit(sharded)
+
+
+def _lm_eval(plan: Plan, b: Bindings) -> Callable:
+    """GSPMD LM eval lowerings (make_lm_eval_step /
+    make_lm_indexed_eval_step bodies)."""
+    from tpu_dist.engine.lm_steps import _lm_eval_metrics, zeros_lm_metrics
+
+    mesh = b.mesh
+    model = b.model
+    data_axis = plan.data_axis
+    loss_chunk = plan.loss_chunk
+    repl = NamedSharding(mesh, P())
+
+    if plan.window != "indexed":
+        batch_sh = NamedSharding(mesh, P(data_axis))
+
+        def step(params, inputs, targets, valid):
+            mask = jnp.broadcast_to(valid[:, None], targets.shape).astype(
+                jnp.float32)
+            return _lm_eval_metrics(model, params, inputs, targets, mask,
+                                    loss_chunk)
+
+        return jax.jit(step, in_shardings=(None, batch_sh, batch_sh,
+                                           batch_sh),
+                       out_shardings=NamedSharding(mesh, P()))
+
+    idx_sh = NamedSharding(mesh, P(None, data_axis))
+
+    def step(params, rows_all, idx, valid):
+        def body(sums, blk):
+            idx_b, valid_b = blk
+            rows = jnp.take(rows_all, idx_b, axis=0)
+            inputs, targets = rows[:, :-1], rows[:, 1:]
+            mask = jnp.broadcast_to(valid_b[:, None], targets.shape).astype(
+                jnp.float32)
+            m = _lm_eval_metrics(model, params, inputs, targets, mask,
+                                 loss_chunk)
+            return jax.tree.map(jnp.add, sums, m), None
+
+        sums, _ = jax.lax.scan(body, zeros_lm_metrics(), (idx, valid))
+        return sums
+
+    return jax.jit(step, in_shardings=(None, repl, idx_sh, idx_sh),
+                   out_shardings=repl)
+
+
+# ---- dispatch -------------------------------------------------------------
+
+def _lower_train(plan: Plan, b: Bindings) -> Callable:
+    if plan.engine == "image":
+        if plan.grad_accum_steps > 1:
+            return _image_accum_train(plan, b)
+        if plan.sync == "explicit":
+            return _image_explicit_train(plan, b)
+        return _image_train(plan, b)
+    if plan.grad_accum_steps > 1:
+        return _lm_accum_train(plan, b)
+    if plan.layout == "sp":
+        return _lm_sp_train(plan, b)
+    if plan.sync == "explicit":
+        return _lm_explicit_train(plan, b)
+    return _lm_train(plan, b)
+
+
+def _lower_eval(plan: Plan, b: Bindings) -> Callable:
+    if plan.engine == "image":
+        return _image_eval(plan, b)
+    if plan.layout == "sp":
+        return _lm_sp_eval(plan, b)
+    return _lm_eval(plan, b)
+
+
+# ---- plan activation + the config knob ------------------------------------
+
+def activate_plan(plan: Plan) -> None:
+    """Apply the plan's global TRACE-TIME switches: the fused int8 Pallas
+    kernel dispatch (ops.quant.set_fused_quant) and the searchable Pallas
+    block sizes (ops.pallas_quant / pallas_sgd / pallas_adamw). Call
+    BEFORE building step functions — these are read at trace time."""
+    from tpu_dist.ops import pallas_adamw, pallas_quant, pallas_sgd
+    from tpu_dist.ops.quant import set_fused_quant
+
+    set_fused_quant({"auto": None, "on": True, "off": False}[
+        plan.fused_quant])
+    pallas_quant.set_quant_blocks(*plan.quant_block)
+    pallas_sgd.set_block_rows(plan.opt_block_rows)
+    pallas_adamw.set_block_rows(plan.opt_block_rows)
+
+
+def _auto_workload(cfg, engine: str) -> dict:
+    """A tuner workload from a config (the 'auto' knob's input): crude
+    param counts are fine — the search ranks knobs, it does not predict
+    wall time."""
+    if engine == "lm":
+        n = (cfg.vocab_size * cfg.d_model
+             + cfg.num_layers * 12 * cfg.d_model * cfg.d_model)
+        toks = cfg.batch_size * cfg.seq_len
+    else:
+        n = 25_000_000                       # resnet50-scale placeholder
+        toks = cfg.batch_size
+    return {"engine": engine, "n_params": float(n),
+            "tokens_per_step": float(toks),
+            "devices": jax.device_count()}
+
+
+def _auto_filter(cfg, engine: str):
+    """Prune 'auto' candidates to what THIS config can legally run (an
+    explicit plan file is applied as-is and may fail loudly; auto must
+    never break a working config)."""
+    mesh_shape = getattr(cfg, "mesh_shape", None) or ()
+    mesh_axes = tuple(getattr(cfg, "mesh_axes", ("data",)))
+    multi = {a for a, s in zip(mesh_axes, mesh_shape) if a != "data"
+             and (s is None or s > 1)}
+    pure_dp = not multi and not getattr(cfg, "fsdp", False)
+    accum = getattr(cfg, "grad_accum_steps", 1) > 1
+    host_data = getattr(cfg, "data_placement", "auto") == "host"
+    quant_ok = (engine == "lm"
+                or getattr(cfg, "arch", "").startswith("vit"))
+
+    def keep(plan: Plan) -> bool:
+        if plan.quant != "none" and not quant_ok:
+            return False
+        if plan.grad_bucket_mb > 0 and not (pure_dp and not accum):
+            return False
+        if plan.sync == "explicit" and not pure_dp:
+            return False
+        if plan.window != "none" and (host_data or accum):
+            return False
+        if plan.window != "none" and engine == "image" \
+                and getattr(cfg, "dataset", "") == "imagenet":
+            # imagefolder datasets are not HBM-resident ArrayDatasets;
+            # the indexed window would refuse at Trainer init
+            return False
+        return True
+
+    return keep
+
+
+def resolve_config_plan(cfg):
+    """Implement the configs' ``plan`` knob: ``''``/``'none'`` -> no-op;
+    a path -> load the (per-device-kind) plan file; ``'auto'`` -> run the
+    tuner's analytic search for this device kind, pruned to what the
+    config can run. Returns ``(new_cfg, plan_info | None)`` where
+    plan_info is the {'source', 'hash', 'knobs', 'plan'} record the
+    engines stamp into run_start + the ``plan`` ledger event. Applies the
+    plan's trace-time switches (:func:`activate_plan`) as a side effect.
+    """
+    spec = getattr(cfg, "plan", "") or ""
+    if spec in ("", "none"):
+        return cfg, None
+    from tpu_dist.plan import ir
+
+    engine = "image" if any(f.name == "variant"
+                            for f in dataclasses.fields(type(cfg))) \
+        else "lm"
+    device_kind = getattr(jax.devices()[0], "device_kind", "unknown")
+    if spec == "auto":
+        from tpu_dist.plan import tune as tune_mod
+        keep = _auto_filter(cfg, engine)
+        # knobs the auto space does NOT search are carried from the
+        # config, never reset to Plan defaults — 'auto' tunes what it
+        # explores and must leave the rest of a working config alone
+        # (precision/bf16, grad accumulation, chunked CE, health policy,
+        # tp_impl all stay the user's choice)
+        carried = {k: getattr(cfg, k) for k in
+                   ("precision", "grad_accum_steps", "health", "tp_impl")
+                   if hasattr(cfg, k)}
+        if engine == "lm":
+            carried["loss_chunk"] = getattr(cfg, "loss_chunk", 0)
+        space = []
+        for p in tune_mod.default_space(engine, jax.device_count()):
+            try:
+                p = dataclasses.replace(p, **carried).validate()
+            except PlanError:
+                continue   # carried knobs make this candidate illegal
+            if keep(p):
+                space.append(p)
+        if not space:
+            # abstaining must be LOUD: "the tuner found nothing legal for
+            # this config" (e.g. tp_impl='ring' — outside the searched
+            # space) is different from "the tuner never ran"
+            import sys
+            print("plan=auto: no legal candidate plans for this config "
+                  "(its knobs fall outside the searched space); running "
+                  "with the hand-set knobs", file=sys.stderr)
+            return cfg, None
+        result = tune_mod.search(workload=_auto_workload(cfg, engine),
+                                 device_kind=device_kind, space=space)
+        if result["best"] is None:
+            return cfg, None
+        plan = result["best"]["plan"]
+        source = "auto"
+    else:
+        plans = ir.load_plan_file(spec)
+        plan = ir.plan_for_device(plans, device_kind)
+        source = spec
+    if plan.engine != engine:
+        raise PlanError(f"plan engine {plan.engine!r} does not drive the "
+                        f"{engine} engine (plan source: {source})")
+    new_cfg = apply_plan_to_config(cfg, plan)
+    activate_plan(plan)
+    info = {"source": source, "hash": plan_hash(plan),
+            "knobs": plan_knob_summary(plan), "plan": plan,
+            "device_kind": device_kind}
+    return new_cfg, info
