@@ -26,8 +26,13 @@ def emit_serving_well(ledger):
     ledger.emit("request", rid=7, tokens=12, queue_wait_s=0.25,
                 admit_ts=1.0, first_token_ts=1.5, finish_ts=2.0,
                 prompt_len=8, ttft_s=0.5)
+    # round 16: the pressure snapshot carries the prefix-sharing and
+    # speculative-acceptance counters (shared/cow/hits required; the
+    # spec_* trend fields ride as extras)
     ledger.emit("kv_cache", pages_free=3, pages_used=13, active_seqs=4,
-                pages_total=16, high_water_used=16, slots=4, tick=40)
+                shared_pages=2, cow_copies=1, prefix_hits=6,
+                pages_total=16, high_water_used=16, slots=4, tick=40,
+                spec_emitted=80, spec_slot_ticks=40)
 
 
 def emit_scale_well(ledger):

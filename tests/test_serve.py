@@ -11,7 +11,14 @@ The pins that matter:
   both numbers are schedule math, not wall clocks);
 * a forced overload sheds new work through SLO-aware admission control,
   emitting `slo` + rejection events that reach the flight recorder and the
-  Prometheus gauges through the NORMAL sink fan-out (zero new plumbing).
+  Prometheus gauges through the NORMAL sink fan-out (zero new plumbing);
+* speculative decoding (round 16) emits BITWISE the non-speculative greedy
+  stream for ANY draft — a perfect draft multiplies tokens/tick, a
+  hostile draft degrades to >=1 token/tick, never to wrong tokens;
+* copy-on-write prefix caching (round 16) maps repeated prompts onto
+  shared refcounted pages at bit-identical output, forking only the one
+  divergent frontier page — and the refcount discipline is pinned
+  (double-free raises, sharing never inflates the footprint).
 """
 
 import itertools
@@ -88,11 +95,17 @@ def test_paged_greedy_bit_identical_to_generate():
     _assert_serve_matches_generate(lm, params)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): dtype twin of the fp32 pin —
+# the paged==contiguous discipline stays in-budget via the mixed-length
+# test_paged_greedy_bit_identical_to_generate
 def test_paged_greedy_bit_identical_bf16():
     lm, params = _lm_and_params(seed=5, dtype=jnp.bfloat16)
     _assert_serve_matches_generate(lm, params, n_reqs=1)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): quant twin; the int8_wo paged
+# serving path stays pinned bit-for-bit against quantized generate
+# in-budget by test_spec_decode_bit_identical_int8_wo
 def test_paged_greedy_bit_identical_int8_wo():
     lm, params = _lm_and_params(seed=6)
     _assert_serve_matches_generate(lm, params, quant="int8_wo", n_reqs=1)
@@ -352,6 +365,171 @@ def test_drain_finishes_inflight_sheds_queue_and_frees_pages():
     assert not eng.submit(DecodeRequest(99, np.array([1], np.int32), 2))
     assert eng.drain() == []
     assert sum(1 for r in led_records if r["event"] == "run_end") == 1
+
+
+# ------------------------------------- speculative decoding (round 16)
+def _greedy_refs(lm, params, prompts, steps, quant="none"):
+    return [np.asarray(generate(lm, params, jnp.asarray(p[None]), steps=s,
+                                use_cache=True, quant=quant))[0]
+            for p, s in zip(prompts, steps)]
+
+
+def test_spec_decode_greedy_bit_identical_to_generate():
+    """Self-speculation (draft == base) with k=3 over mixed-length
+    requests: every emitted stream is BITWISE the non-speculative greedy
+    decode — speculation is a throughput optimization, never a model
+    change — and an always-agreeing draft clears >1 token per slot-tick,
+    finishing in strictly fewer ticks than one-token-per-tick decode."""
+    lm, params = _lm_and_params(seed=16)
+    prompts = [np.array([1, 9, 17], np.int32), np.array([5], np.int32)]
+    steps = [10, 12]
+    refs = _greedy_refs(lm, params, prompts, steps)
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=2, page_size=8, num_pages=16, spec_k=3))
+    comps = eng.run([DecodeRequest(i, p, s)
+                     for i, (p, s) in enumerate(zip(prompts, steps))])
+    assert len(comps) == 2
+    for c in comps:
+        np.testing.assert_array_equal(refs[c.rid], c.tokens)
+    assert eng.accepted_per_tick > 1.0
+    assert eng.ticks < max(steps)         # sublinear in emitted tokens
+
+
+def test_spec_decode_bit_identical_int8_wo():
+    """The quantized twin: the draft rides the same int8_wo tree through
+    the memoized quantize path; the verified stream stays bitwise the
+    quantized ``generate``."""
+    lm, params = _lm_and_params(seed=17)
+    prompts = [np.array([2, 11, 23], np.int32)]
+    refs = _greedy_refs(lm, params, prompts, [10], quant="int8_wo")
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=1, page_size=8, num_pages=16, quant="int8_wo",
+        spec_k=2))
+    comps = eng.run([DecodeRequest(0, prompts[0], 10)])
+    np.testing.assert_array_equal(refs[0], comps[0].tokens)
+    assert eng.accepted_per_tick > 1.0
+
+
+def test_spec_reject_storm_still_progresses_bit_identical():
+    """A deliberately wrong draft (same architecture, different random
+    init) rejects nearly every proposal. The emission rule still commits
+    the base model's own greedy correction every tick — >=1 token per
+    slot-tick, output bitwise the non-speculative stream. A bad draft
+    costs throughput, never correctness."""
+    lm, params = _lm_and_params(seed=18)
+    _, draft_params = _lm_and_params(seed=99)  # same shape, wrong weights
+    prompts = [np.array([1, 2, 3], np.int32)]
+    refs = _greedy_refs(lm, params, prompts, [10])
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=1, page_size=8, num_pages=16, spec_k=3),
+        draft_model=lm, draft_params=draft_params)
+    comps = eng.run([DecodeRequest(0, prompts[0], 10)])
+    np.testing.assert_array_equal(refs[0], comps[0].tokens)
+    assert eng.accepted_per_tick >= 1.0   # the progress floor
+    assert eng.accepted_per_tick < 3.0    # the storm actually rejected
+
+
+def test_spec_guards_reject_bad_configs():
+    lm, params = _lm_and_params(seed=19)
+    small = tiny_lm(vocab_size=32, num_layers=1, d_model=32, num_heads=2,
+                    max_len=L)
+    small_params = small.init({"params": jax.random.PRNGKey(0)},
+                              jnp.zeros((1, L), jnp.int32),
+                              train=False)["params"]
+    with pytest.raises(ValueError, match="vocab"):
+        ServeEngine(lm, params, ServeConfig(spec_k=2),
+                    draft_model=small, draft_params=small_params)
+    with pytest.raises(ValueError, match="spec_k"):
+        ServeEngine(lm, params, ServeConfig(),
+                    draft_model=lm, draft_params=params)
+    with pytest.raises(ValueError, match="temperature"):
+        ServeEngine(lm, params, ServeConfig(spec_k=2, temperature=0.5))
+
+
+# ------------------------------------- CoW prefix caching (round 16)
+def test_prefix_cache_cow_bit_identical_and_saves_pages():
+    """Three requests with the SAME 18-token prompt (page_size 4: four
+    full pages + a 2-token frontier) under ``prefix_cache``: outputs are
+    bitwise the uncached greedy stream, the 2nd/3rd admission map the
+    hot prompt onto shared pages, and each forks exactly ONE page — the
+    frontier it is about to overwrite. Fresh allocations drop from 18
+    (3x6 unshared) to 10, the pinned sublinear footprint."""
+    lm, params = _lm_and_params(seed=20)
+    prompt = ((np.arange(18, dtype=np.int32) * 5 + 3) % V).astype(np.int32)
+    ref = _greedy_refs(lm, params, [prompt], [6])[0]
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=3, page_size=4, num_pages=32, prefix_cache=True))
+    comps = eng.run([DecodeRequest(i, prompt, 6) for i in range(3)])
+    assert len(comps) == 3
+    for c in comps:
+        np.testing.assert_array_equal(ref, c.tokens)
+    pool = eng.pool
+    assert pool.alloc_total == 6 + 2 + 2   # vs 18 without sharing
+    assert pool.cow_copies == 2            # one frontier fork per sharer
+    assert pool.prefix_hits == 10          # 5 prompt pages x 2 sharers
+    assert eng.prefix_hit_rate == pytest.approx(10 / 15)
+    assert eng.stats()["pages_per_request"] == pytest.approx(10 / 3)
+    assert pool.pages_free == pool.num_pages   # cached pages still count
+
+
+def test_spec_and_prefix_cache_compose_bit_identical():
+    """Both round-16 features on at once (the serving configuration the
+    bench publishes): shared-prefix admissions + speculative ticks still
+    produce the exact non-speculative, uncached token streams."""
+    lm, params = _lm_and_params(seed=21)
+    prompt = ((np.arange(9, dtype=np.int32) * 7 + 1) % V).astype(np.int32)
+    ref = _greedy_refs(lm, params, [prompt], [8])[0]
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=2, page_size=4, num_pages=32, spec_k=2,
+        prefix_cache=True))
+    comps = eng.run([DecodeRequest(i, prompt, 8) for i in range(2)])
+    assert len(comps) == 2
+    for c in comps:
+        np.testing.assert_array_equal(ref, c.tokens)
+    assert eng.accepted_per_tick > 1.0
+    assert eng.pool.prefix_hits > 0
+
+
+# ------------------------------------- pool refcounts + heap (round 16)
+def test_pool_refcount_double_free_and_leak_pins():
+    """The CoW refcount discipline: double-free raises (a silently
+    recycled page corrupts another sequence's cache), a shared page
+    survives its first holder's release, and a full share/release cycle
+    leaks nothing — high_water_used stays at the unshared peak because
+    sharing never inflates the physical footprint."""
+    pool = PagedKVPool(num_layers=1, num_pages=8, page_size=4,
+                       num_heads=2, head_dim=8)
+    a = pool.alloc(2)
+    pool.free(a)
+    with pytest.raises(ValueError, match="double-free"):
+        pool.free(a)
+    prompt = np.arange(8, dtype=np.int32)     # two full pages
+    b = pool.alloc(2)
+    pool.register_prefix(prompt, b)
+    m = pool.share_prefix(prompt)
+    assert m.full == 2 and not m.partial and m.pages == b
+    assert pool.shared_pages == 2
+    pool.free(b)                  # first holder out: pages stay live
+    assert pool.shared_pages == 0 and pool.pages_used == 2
+    pool.free(m.pages)            # last ref: parked as reclaimable cache
+    assert pool.pages_used == 0
+    assert pool.pages_free == pool.num_pages      # no leak
+    assert pool.high_water_used == 2              # sharing added nothing
+    with pytest.raises(ValueError, match="double-free"):
+        pool.free(m.pages)
+
+
+def test_pool_heap_grants_lowest_index_first():
+    """Round 16 swapped the free list's O(n log n) full-sort-per-free
+    for a heap; the observable grant order is pinned unchanged —
+    lowest index first, whatever order pages came back in."""
+    pool = PagedKVPool(num_layers=1, num_pages=8, page_size=4,
+                       num_heads=2, head_dim=8)
+    assert pool.alloc(6) == [0, 1, 2, 3, 4, 5]
+    pool.free([4, 1, 3])
+    assert pool.alloc(3) == [1, 3, 4]
+    pool.free([5, 0, 2])
+    assert pool.alloc(4) == [0, 2, 5, 6]
 
 
 def test_sigterm_routes_run_into_drain():

@@ -113,6 +113,11 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
             "vs_baseline": parsed.get("vs_baseline"),
             "data_s": data_s,
             "serving_rpt": serving.get("requests_per_tick"),
+            # round 16+: speculative acceptance (higher is better) and
+            # fresh pages per request (LOWER is better — the prefix
+            # cache's number); pre-spec history abstains like the rest
+            "serving_apt": serving.get("accepted_per_tick"),
+            "serving_ppr": serving.get("pages_per_request"),
             "fleet_goodput": fleet.get("goodput_ratio"),
             "round": rnd,
             "file": os.path.basename(path),
@@ -167,6 +172,25 @@ def track(points: List[dict], threshold_pct: float,
         srv_regressed = (srv_best is not None and srv_latest is not None
                          and (srv_best - srv_latest) / srv_best * 100.0
                          > threshold_pct)
+        # speculative acceptance (round 16+): higher is better, same
+        # abstention convention (pre-spec history carries no field)
+        prior_apt = [p["serving_apt"] for p in prior
+                     if p.get("serving_apt") is not None]
+        apt_best = max(prior_apt, default=None)
+        apt_latest = latest.get("serving_apt")
+        apt_regressed = (apt_best is not None and apt_latest is not None
+                         and (apt_best - apt_latest) / apt_best * 100.0
+                         > threshold_pct)
+        # fresh pages per request (round 16+): LOWER is better — the gate
+        # reverses (judged against the best = lowest prior, fails on RISE)
+        prior_ppr = [p["serving_ppr"] for p in prior
+                     if p.get("serving_ppr") is not None]
+        ppr_best = min(prior_ppr, default=None)
+        ppr_latest = latest.get("serving_ppr")
+        ppr_regressed = (ppr_best is not None and ppr_latest is not None
+                         and ppr_best > 0
+                         and (ppr_latest - ppr_best) / ppr_best * 100.0
+                         > threshold_pct)
         # fleet goodput ratio (tpu_dist.sim): higher is better, judged
         # against the best prior point CARRYING a fleet block — pre-fleet
         # history abstains, exactly the data_s/serving convention
@@ -195,11 +219,18 @@ def track(points: List[dict], threshold_pct: float,
             "serving_latest": srv_latest,
             "serving_best_prior": srv_best,
             "serving_regressed": srv_regressed,
+            "accepted_latest": apt_latest,
+            "accepted_best_prior": apt_best,
+            "accepted_regressed": apt_regressed,
+            "pages_latest": ppr_latest,
+            "pages_best_prior": ppr_best,
+            "pages_regressed": ppr_regressed,
             "fleet_latest": fleet_latest,
             "fleet_best_prior": fleet_best,
             "fleet_regressed": fleet_regressed,
         }
-        if regressed or data_regressed or srv_regressed or fleet_regressed:
+        if (regressed or data_regressed or srv_regressed or apt_regressed
+                or ppr_regressed or fleet_regressed):
             report["ok"] = False
     return report
 
@@ -242,6 +273,29 @@ def render(report: dict, out=print) -> None:
             else:
                 out(f"  -> serving: {m['serving_latest']:.4f} req/tick "
                     "(no prior serving history; nothing to judge)")
+        if m.get("accepted_latest") is not None:
+            if m.get("accepted_best_prior") is not None:
+                verdict = ("ACCEPTANCE REGRESSED"
+                           if m["accepted_regressed"] else "ok")
+                out(f"  -> spec {verdict}: {m['accepted_latest']:.4f} "
+                    f"accepted/tick vs best prior "
+                    f"{m['accepted_best_prior']:.4f} (threshold "
+                    f"{report['threshold_pct']:g}%)")
+            else:
+                out(f"  -> spec: {m['accepted_latest']:.4f} accepted/tick "
+                    "(no prior speculative history; nothing to judge)")
+        if m.get("pages_latest") is not None:
+            if m.get("pages_best_prior") is not None:
+                verdict = ("PAGES REGRESSED" if m["pages_regressed"]
+                           else "ok")
+                out(f"  -> pages {verdict}: {m['pages_latest']:.4f} "
+                    f"fresh pages/request vs best (lowest) prior "
+                    f"{m['pages_best_prior']:.4f} (threshold "
+                    f"{report['threshold_pct']:g}%, lower is better)")
+            else:
+                out(f"  -> pages: {m['pages_latest']:.4f} fresh "
+                    "pages/request (no prior prefix-cache history; "
+                    "nothing to judge)")
         if m.get("fleet_latest") is not None:
             if m.get("fleet_best_prior") is not None:
                 verdict = ("FLEET REGRESSED" if m["fleet_regressed"]
@@ -307,7 +361,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if (args.check or args.headline) and not report["ok"]:
         bad = [k for k, m in report["metrics"].items()
                if m["regressed"] or m.get("data_s_regressed")
-               or m.get("serving_regressed") or m.get("fleet_regressed")]
+               or m.get("serving_regressed") or m.get("accepted_regressed")
+               or m.get("pages_regressed") or m.get("fleet_regressed")]
         print(f"bench_track: REGRESSION in {bad}", file=sys.stderr)
         return 1
     return 0

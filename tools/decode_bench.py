@@ -26,7 +26,12 @@ deterministic twin), TTFT and per-output-token latency p50/p99, batch
 occupancy, and the static baseline — making throughput-UNDER-LOAD the
 recorded metric; ``tools/bench_track.py`` gates on it like ``data_s``.
 Arrivals are scheduled in TICK units from a seeded rng, so the schedule
-(and the per-tick numbers) are machine-speed-independent.
+(and the per-tick numbers) are machine-speed-independent. ``--spec-k``
+runs the trace through the speculative tick (``accepted_per_tick`` joins
+the block), and ``--prefix-tenants``/``--prefix-len`` give requests
+shared per-tenant system prompts with CoW prefix caching on — plus a
+cache-off baseline replay, so the ``pages_per_request`` drop is on
+record (``prefix_hit_rate`` says why).
 
 Usage:
     python tools/decode_bench.py                         # both paths
@@ -85,7 +90,18 @@ def replay_serving_trace(args, model, params, ledger=None):
     through continuous batching AND through static drain-batching at equal
     slot capacity; the returned dict is the headline's ``serving`` block.
     A warm pass (full replay, discarded) pays the prefill-bucket and tick
-    compiles so both timed modes run warm."""
+    compiles so both timed modes run warm.
+
+    ``--prefix-tenants T`` prepends one of T fixed per-tenant system
+    prompts (``--prefix-len`` tokens, seeded) to every request — the
+    shared-prefix traffic shape real multi-tenant serving has — and
+    enables copy-on-write prefix caching; a third replay with the cache
+    OFF becomes the ``no_prefix_cache`` baseline, so the
+    ``pages_per_request`` drop is measured, not asserted. ``--spec-k``
+    runs the speculative tick (self-speculation: the base drafts for
+    itself) and publishes ``accepted_per_tick``. Both knobs only shape
+    the seeded schedule deterministically — per-tick numbers stay
+    machine-independent."""
     import numpy as np
 
     from tpu_dist.engine.serve import ServeConfig, ServeEngine
@@ -99,29 +115,50 @@ def replay_serving_trace(args, model, params, ledger=None):
                             ).astype(np.int32)
                for _ in range(args.trace)]
     outs = rng.integers(args.min_out, args.max_out + 1, args.trace)
-    max_total = args.max_prompt + args.max_out
+    prefix_on = args.prefix_tenants > 0
+    prefix_len = args.prefix_len if prefix_on else 0
+    if prefix_on:
+        # per-tenant system prompts, drawn AFTER the base trace so the
+        # pre-existing schedule (and its tracked numbers) is unchanged
+        # when the knob is off
+        tenants = [rng.integers(0, args.vocab_size,
+                                (args.prefix_len,)).astype(np.int32)
+                   for _ in range(args.prefix_tenants)]
+        tenant_of = rng.integers(0, args.prefix_tenants, args.trace)
+        prompts = [np.concatenate([tenants[tenant_of[j]], prompts[j]])
+                   for j in range(args.trace)]
+    max_total = prefix_len + args.max_prompt + args.max_out
     pages_per_seq = -(-max_total // args.page_size)
     num_pages = args.num_pages or args.serve_slots * pages_per_seq
 
-    def make(refill, led=None):
+    def make(refill, led=None, prefix_cache=prefix_on):
         return ServeEngine(model, params, ServeConfig(
             max_slots=args.serve_slots, page_size=args.page_size,
             num_pages=num_pages, max_len=max_total,
             quant=args.serve_quant, kv_quant=args.kv_quant,
             temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, refill=refill,
+            top_p=args.top_p, refill=refill, spec_k=args.spec_k,
+            prefix_cache=prefix_cache,
             kv_event_every=32), ledger=led)
 
     _drive_trace(make("continuous"), arrivals, prompts, outs)  # warm
     results = {}
-    for refill in ("continuous", "drain"):
-        eng = make(refill, led=ledger if refill == "continuous" else None)
+    modes = [("continuous", True), ("drain", True)]
+    if prefix_on:
+        # the CoW baseline: same trace, same scheduler, cache off — the
+        # pages_per_request delta is the prefix cache's whole claim
+        modes.append(("no_prefix_cache", False))
+    for refill, prefix_cache in modes:
+        eng = make("continuous" if refill == "no_prefix_cache" else refill,
+                   led=ledger if refill == "continuous" else None,
+                   prefix_cache=prefix_cache)
         comps, elapsed = _drive_trace(eng, arrivals, prompts, outs)
         ttft = [c.ttft_s for c in comps]
         tpot = [(c.finish_ts - c.first_token_ts) / (c.n_generated - 1)
                 for c in comps if c.n_generated > 1]
         waits = [c.queue_wait_s for c in comps]
         toks = sum(c.n_generated for c in comps)
+        apt = eng.accepted_per_tick
         results[refill] = {
             "completed": len(comps), "rejected": eng.rejected,
             "ticks": eng.ticks,
@@ -132,6 +169,18 @@ def replay_serving_trace(args, model, params, ledger=None):
             "tokens_per_sec": (round(toks / elapsed, 1)
                                if elapsed else None),
             "occupancy": round(eng.occupancy, 4),
+            # per-active-slot tokens per tick: identically 1.0 for the
+            # plain tick, > 1.0 once speculative acceptance lands
+            "accepted_per_tick": (round(apt, 4) if apt is not None
+                                  else (1.0 if eng.ticks else None)),
+            # fresh pages granted per completed request — the number the
+            # prefix cache exists to shrink
+            "pages_per_request": (round(eng.pool.alloc_total / len(comps),
+                                        4) if comps else None),
+            "prefix_hit_rate": (round(eng.prefix_hit_rate, 4)
+                                if eng.prefix_hit_rate is not None
+                                else None),
+            "cow_copies": eng.pool.cow_copies,
             "ttft_ms": {"p50": _pctl_ms(ttft, 50),
                         "p99": _pctl_ms(ttft, 99)},
             "tpot_ms": {"p50": _pctl_ms(tpot, 50),
@@ -141,7 +190,9 @@ def replay_serving_trace(args, model, params, ledger=None):
         }
         print(f"serve[{refill}]: {len(comps)}/{args.trace} completed in "
               f"{eng.ticks} ticks ({results[refill]['requests_per_tick']} "
-              f"req/tick, {results[refill]['requests_per_sec']} req/s), "
+              f"req/tick, {results[refill]['requests_per_sec']} req/s, "
+              f"{results[refill]['accepted_per_tick']} accepted/tick, "
+              f"{results[refill]['pages_per_request']} pages/req), "
               f"occupancy {eng.occupancy * 100:.0f}%, TTFT p50 "
               f"{results[refill]['ttft_ms']['p50']}ms", file=sys.stderr)
     serving = dict(results["continuous"])
@@ -152,7 +203,12 @@ def replay_serving_trace(args, model, params, ledger=None):
     serving["kv_quant"] = args.kv_quant
     serving["arrival_rate"] = args.arrival_rate
     serving["trace_seed"] = args.trace_seed
+    serving["spec_k"] = args.spec_k
+    serving["prefix_tenants"] = args.prefix_tenants
+    serving["prefix_len"] = prefix_len
     serving["static"] = results["drain"]
+    if prefix_on:
+        serving["no_prefix_cache"] = results["no_prefix_cache"]
     return serving
 
 
@@ -208,6 +264,21 @@ def main():
     ap.add_argument("--max-prompt", type=int, default=32)
     ap.add_argument("--min-out", type=int, default=4)
     ap.add_argument("--max-out", type=int, default=64)
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative decoding for the trace replay: this "
+                         "many greedy draft tokens per tick "
+                         "(self-speculation; 0 = plain decode). Greedy "
+                         "output is token-identical either way — only "
+                         "accepted_per_tick moves")
+    ap.add_argument("--prefix-tenants", type=int, default=0,
+                    help="shared-prefix traffic for the trace replay: "
+                         "each request gets one of this many fixed "
+                         "per-tenant system prompts prepended, and "
+                         "copy-on-write prefix caching turns on (plus a "
+                         "cache-off baseline replay). 0 = off")
+    ap.add_argument("--prefix-len", type=int, default=32,
+                    help="tokens per tenant system prompt "
+                         "(with --prefix-tenants)")
     ap.add_argument("--serve-slots", type=int, default=4)
     ap.add_argument("--page-size", type=int, default=16)
     ap.add_argument("--num-pages", type=int, default=0,
@@ -246,8 +317,9 @@ def main():
     total = args.prompt_len + args.steps
     # the pos_emb table must cover the longest sequence either mode runs:
     # the one-shot geometry AND the trace replay's worst case
-    max_len = max(total, (args.max_prompt + args.max_out) if args.trace
-                  else 0)
+    max_len = max(total, (args.max_prompt + args.max_out
+                          + (args.prefix_len if args.prefix_tenants else 0))
+                  if args.trace else 0)
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
     if args.num_experts:
         from tpu_dist.models.moe import MoETransformerLM

@@ -361,6 +361,14 @@ def decode_section(records, out=print):
             last = kv[-1]
             srv["pages_free_last"] = last.get("pages_free")
             srv["high_water_used"] = last.get("high_water_used")
+            # round 16: speculative-acceptance and prefix-hit TRENDS over
+            # the periodic snapshots (counters are cumulative, so per-
+            # window rates come from consecutive deltas: first -> last)
+            srv["spec_acceptance"] = _counter_trend(
+                kv, "spec_emitted", "spec_slot_ticks")
+            srv["prefix_hits_last"] = last.get("prefix_hits")
+            srv["cow_copies_last"] = last.get("cow_copies")
+            srv["shared_pages_last"] = last.get("shared_pages")
         d["serving"] = srv
         out(f"\nserving: {srv['completed']} completed, {rejected} rejected"
             + (f", occupancy {srv['occupancy'] * 100:.0f}%"
@@ -371,7 +379,42 @@ def decode_section(records, out=print):
             + (f"; TTFT p50 {srv['ttft_s']['p50'] * 1e3:.1f}ms"
                f" / p99 {srv['ttft_s']['p99'] * 1e3:.1f}ms"
                if ttfts else ""))
+        sa = srv.get("spec_acceptance")
+        if sa is not None:
+            out("  speculative acceptance: "
+                + f"{sa['overall']:.2f} tokens/slot-tick overall"
+                + (f" (first window {sa['first']:.2f} -> last "
+                   f"{sa['last']:.2f})"
+                   if sa.get("first") is not None else ""))
+        if srv.get("prefix_hits_last"):
+            out(f"  prefix cache: {srv['prefix_hits_last']} page hits, "
+                f"{srv['cow_copies_last'] or 0} CoW forks, "
+                f"{srv['shared_pages_last'] or 0} pages shared at last "
+                "snapshot")
     return d
+
+
+def _counter_trend(kv, num_key, den_key):
+    """Overall + first/last per-window rate of two CUMULATIVE counters
+    across the periodic ``kv_cache`` snapshots (None when the counters
+    never moved — plain non-speculative serving)."""
+    pts = [(r.get(num_key), r.get(den_key)) for r in kv
+           if r.get(num_key) is not None and r.get(den_key) is not None]
+    if not pts or not pts[-1][1]:
+        return None
+    trend = {"overall": round(pts[-1][0] / pts[-1][1], 4),
+             "first": None, "last": None}
+    deltas = []
+    prev = (0, 0)
+    for num, den in pts:
+        dn, dd = num - prev[0], den - prev[1]
+        if dd > 0:
+            deltas.append(dn / dd)
+        prev = (num, den)
+    if deltas:
+        trend["first"] = round(deltas[0], 4)
+        trend["last"] = round(deltas[-1], 4)
+    return trend
 
 
 def summarize(records, out=print):

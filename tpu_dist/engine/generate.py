@@ -173,6 +173,35 @@ def generate(model, params, prompt: jax.Array, steps: int,
     return out
 
 
+def prepare_draft(base_model, draft_model, draft_params, quant: str):
+    """Validate + quantize a speculative-decoding DRAFT tree against its
+    base (``engine.serve`` calls this once at engine construction).
+
+    The draft proposes token IDS the base verifies, so the vocabularies
+    must be literally the same space — a mismatched draft would propose
+    ids the base never emits and silently decode at acceptance ~0. Depth,
+    width and heads are free to differ (that is the whole point: a
+    shallower draft makes k cheap proposals per one base verification).
+    The draft rides the same weight-quant mode as the base, through the
+    same memoized :func:`_quantize_for_decode` path, so a serving process
+    holding base+draft trees quantizes each exactly once."""
+    if getattr(draft_model, "vocab_size", None) != base_model.vocab_size:
+        raise ValueError(
+            f"draft vocab_size={getattr(draft_model, 'vocab_size', None)} "
+            f"!= base vocab_size={base_model.vocab_size}: speculative "
+            "verification compares token ids, so the vocabularies must "
+            "be the same space")
+    if draft_model.max_len < base_model.max_len:
+        raise ValueError(
+            f"draft max_len={draft_model.max_len} < base "
+            f"max_len={base_model.max_len}: the draft must be able to "
+            "sit at every position the base serves")
+    if quant != "none":
+        return _quantize_for_decode(draft_model, draft_params, quant)
+    _refuse_wo_tree(getattr(draft_model, "quant", "none"), draft_params)
+    return draft_model, draft_params
+
+
 def _refuse_wo_tree(effective_mode: str, params) -> None:
     """Raise when a wo-quantized tree meets any decode mode but 'int8_wo':
     plain nn.Dense would silently use the raw int8 kernels as weights

@@ -11,6 +11,20 @@ page indices, and allocation/eviction are O(pages) free-list ops — HBM
 utilization follows *actual* lengths, and there is no fragmentation to
 compact because every page is interchangeable.
 
+Round 16 makes pages SHARED, not just interchangeable: every page carries a
+refcount, and a prefix index keyed by the token-hash of whole pages maps a
+new request's prompt prefix onto the physical pages an identical earlier
+prefix already filled (system prompts and few-shot headers — the dominant
+bytes in real multi-tenant traffic). A prefix hit costs ~0 fresh pages; a
+page whose refcount drops to zero but that is still indexed parks in a
+CACHED set (content preserved, reclaimed FIFO only under pool pressure), so
+hits survive across non-overlapping requests and effective HBM capacity
+multiplies with traffic similarity. Divergence is copy-on-write: the one
+page a new request can ever write while shared — the frontier page holding
+the tail of its prompt — is forked (``ops.paged_attention.cow_fork_pages``)
+onto a destination page reserved at admission, at the moment of the first
+divergent write.
+
 Division of labor: the device-side scatter/gather/attention programs live
 in ``ops.paged_attention`` (this module only *holds* arrays and page
 bookkeeping); the request scheduler that drives both lives in
@@ -27,15 +41,51 @@ the device programs only ever see block tables as arrays.
 
 from __future__ import annotations
 
-from typing import List, Optional
+import hashlib
+import heapq
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_dist.ops.paged_attention import PagedLayer, pages_for
 
 
+def _prefix_key(tokens) -> str:
+    """Content address of a token prefix: sha1 over the raw int32 bytes.
+    Deterministic across runs/processes (unlike ``hash()``), collision-
+    negligible, and O(len) — the whole-page token-hash the prefix index
+    is keyed by."""
+    return hashlib.sha1(
+        np.ascontiguousarray(np.asarray(tokens, np.int32)).tobytes()
+    ).hexdigest()
+
+
+class PrefixMatch:
+    """One admission's prefix-index result (``PagedKVPool.share_prefix``).
+
+    ``pages`` are the shared physical pages, refcounts already bumped, in
+    block-table order; ``full`` of them are whole-page hits (positions
+    ``0..full*page_size`` never rewritten, never forked), and when
+    ``partial`` is set the LAST entry is a frontier page matched through
+    ``cov - full*page_size`` leading rows only — the one page the new
+    request will write into, so it must fork on first write. ``cov`` is
+    the total number of prompt positions whose K/V rows are already
+    resident."""
+
+    __slots__ = ("pages", "full", "partial", "cov")
+
+    def __init__(self, pages: List[int], full: int, partial: bool,
+                 cov: int):
+        self.pages = pages
+        self.full = full
+        self.partial = partial
+        self.cov = cov
+
+
 class PagedKVPool:
-    """Preallocated paged KV arenas + the free-list allocator.
+    """Preallocated paged KV arenas + the refcounting free-list allocator.
 
     ``num_pages`` is the real capacity; arenas carry one extra *trash* page
     (index ``num_pages``) that masked writes are routed to, so the jitted
@@ -43,6 +93,13 @@ class PagedKVPool:
     when the pool cannot satisfy the request — admission control's signal
     to queue (never a partial grant). ``high_water_used`` tracks the peak
     concurrent page usage for the ``kv_cache`` ledger event.
+
+    Allocation states per page: FREE (refcount 0, on the min-heap, grants
+    come lowest-index-first for run-to-run determinism), LIVE (refcount
+    >= 1 — shared when >= 2), or CACHED (refcount 0 but still in the
+    prefix index: content preserved for future hits, reclaimed FIFO when
+    the heap runs dry). ``pages_free`` counts FREE + CACHED — both are
+    allocatable, so admission watermarks see true headroom.
 
     A contiguous allocator serving the same ``max_len``-capable slots would
     need ``slots * pages_for(max_len, page_size)`` pages up front; the pool
@@ -83,38 +140,193 @@ class PagedKVPool:
                 self._layers.append(PagedLayer(
                     jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
                     quant="none", read=read))
-        # lowest-index-first keeps allocation deterministic run to run
+        # a min-heap of free page indices: O(log n) per free/grant instead
+        # of the round-11 full sort() per released request, with the SAME
+        # lowest-index-first grant order (determinism pin in test_serve)
         self._free: List[int] = list(range(num_pages))
+        heapq.heapify(self._free)
+        self._ref: List[int] = [0] * num_pages
+        # rc==0 pages still carrying indexed prefix content, FIFO by
+        # release order (deterministic reclaim under pressure)
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
+        # prefix index: full-prefix sha1 -> page holding its last whole
+        # page of K/V rows, plus parent-hash -> [(page_tokens, page)] for
+        # frontier (partial-page) matches; _reg maps page -> its keys so
+        # reclaim can unregister in O(children)
+        self._full_index: Dict[str, int] = {}
+        self._children: Dict[str, List[Tuple[Tuple[int, ...], int]]] = {}
+        self._reg: Dict[int, Tuple[Optional[str], str,
+                                   Tuple[int, ...]]] = {}
         self.high_water_used = 0
+        # cumulative counters (the kv_cache ledger event + bench headline)
+        self.prefix_hits = 0        # pages served from the index
+        self.prefix_lookups = 0     # share_prefix calls
+        self.cow_copies = 0         # frontier forks performed
+        self.alloc_total = 0        # fresh pages granted (pages/request)
 
     # -- allocator --------------------------------------------------------
     @property
     def pages_free(self) -> int:
-        return len(self._free)
+        """Allocatable pages: truly free + cached (reclaimable) ones."""
+        return len(self._free) + len(self._cached)
 
     @property
     def pages_used(self) -> int:
-        return self.num_pages - len(self._free)
+        return self.num_pages - self.pages_free
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages currently referenced by 2+ sequences."""
+        return sum(1 for r in self._ref if r >= 2)
 
     def pages_needed(self, total_tokens: int) -> int:
         return pages_for(total_tokens, self.page_size)
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Grant ``n`` pages (all-or-nothing; None when short)."""
-        if n > len(self._free):
+        """Grant ``n`` fresh pages at refcount 1 (all-or-nothing; None
+        when short). Free pages go first, lowest index first; cached
+        prefix pages are reclaimed FIFO (and unregistered) only when the
+        free heap runs dry — pool pressure evicts the cache, never the
+        other way around."""
+        if n > self.pages_free:
             return None
-        grant, self._free = self._free[:n], self._free[n:]
+        grant = [heapq.heappop(self._free)
+                 for _ in range(min(n, len(self._free)))]
+        while len(grant) < n:
+            page, _ = self._cached.popitem(last=False)
+            self._unregister(page)
+            grant.append(page)
+        for p in grant:
+            self._ref[p] = 1
+        self.alloc_total += n
         self.high_water_used = max(self.high_water_used, self.pages_used)
         return grant
 
     def free(self, pages: List[int]) -> None:
-        self._free.extend(pages)
-        self._free.sort()
+        """Drop one reference per listed page. A page parks in the cached
+        set when it still carries indexed prefix content, else returns to
+        the free heap. Double-frees raise — a leaked or double-counted
+        page corrupts another sequence's cache silently otherwise."""
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise ValueError(f"double-free of page {p} (refcount 0)")
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                if p in self._reg:
+                    self._cached[p] = None
+                else:
+                    heapq.heappush(self._free, p)
 
     def contiguous_pages_needed(self, slots: int, max_total: int) -> int:
         """What a contiguous per-slot allocator would preallocate for the
         same capacity — the fragmentation comparison baseline."""
         return slots * self.pages_needed(max_total)
+
+    # -- prefix index -----------------------------------------------------
+    def share_prefix(self, prompt: np.ndarray) -> PrefixMatch:
+        """Map the longest resident prefix of ``prompt`` onto shared
+        pages: whole-page hits first (index walk by cumulative prefix
+        hash), then one frontier page whose leading rows match the
+        remaining tail. Bumps refcounts (un-parking cached pages) and
+        returns a :class:`PrefixMatch`; ``unshare`` undoes it when the
+        admission cannot complete."""
+        self.prefix_lookups += 1
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        ps = self.page_size
+        pages: List[int] = []
+        full = 0
+        parent = _prefix_key(prompt[:0])
+        while (full + 1) * ps <= prompt.size:
+            key = _prefix_key(prompt[:(full + 1) * ps])
+            page = self._full_index.get(key)
+            if page is None:
+                break
+            self._retain(page)
+            pages.append(page)
+            full += 1
+            parent = key
+        cov = full * ps
+        partial = False
+        tail = tuple(int(t) for t in prompt[cov:])
+        if tail:
+            for content, page in self._children.get(parent, ()):
+                if len(content) >= len(tail) \
+                        and content[:len(tail)] == tail:
+                    self._retain(page)
+                    pages.append(page)
+                    partial = True
+                    cov += len(tail)
+                    break
+        self.prefix_hits += len(pages)
+        if pages:
+            self.high_water_used = max(self.high_water_used,
+                                       self.pages_used)
+        return PrefixMatch(pages, full, partial, cov)
+
+    def unshare(self, match: PrefixMatch) -> None:
+        """Roll back ``share_prefix`` (admission failed downstream)."""
+        self.free(match.pages)
+        self.prefix_hits -= len(match.pages)
+
+    def _retain(self, page: int) -> None:
+        if self._ref[page] == 0:
+            self._cached.pop(page, None)
+        self._ref[page] += 1
+
+    def register_prefix(self, prompt: np.ndarray, pages: List[int],
+                        skip_slots: int = 0) -> None:
+        """Index a freshly-prefilled prompt's pages for future sharing:
+        whole prompt pages under their cumulative prefix hash, every page
+        (including the final partial one) as a child of its parent hash
+        with its prompt-resident token content — the frontier-match side.
+        ``skip_slots`` leading block-table slots came from ``share_prefix``
+        and are already indexed (registering them again would double-map
+        one hash to two pages)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        ps = self.page_size
+        n_slots = pages_for(prompt.size, ps)
+        for i in range(skip_slots, n_slots):
+            page = pages[i]
+            content = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+            parent = _prefix_key(prompt[:i * ps])
+            is_full = len(content) == ps
+            full_key = _prefix_key(prompt[:(i + 1) * ps]) if is_full \
+                else None
+            if full_key is not None and full_key in self._full_index:
+                continue         # identical prefix already indexed
+            siblings = self._children.setdefault(parent, [])
+            if any(c == content for c, _ in siblings):
+                continue
+            if page in self._reg:
+                continue         # one page, one identity
+            siblings.append((content, page))
+            if full_key is not None:
+                self._full_index[full_key] = page
+            self._reg[page] = (full_key, parent, content)
+
+    def _unregister(self, page: int) -> None:
+        full_key, parent, content = self._reg.pop(page)
+        if full_key is not None:
+            self._full_index.pop(full_key, None)
+        kids = self._children.get(parent)
+        if kids:
+            kids[:] = [(c, p) for c, p in kids if p != page]
+            if not kids:
+                del self._children[parent]
+
+    def fork_page(self, src: int, dst: int) -> None:
+        """Copy-on-write fork: duplicate ``src``'s rows onto the already-
+        granted ``dst`` in every layer's arenas and drop one reference
+        from ``src`` (the forking sequence's). The caller swaps its block
+        table entry; other holders keep reading ``src``."""
+        from tpu_dist.ops.paged_attention import cow_fork_pages
+
+        src_a = jnp.asarray([src], jnp.int32)
+        dst_a = jnp.asarray([dst], jnp.int32)
+        self._layers = list(cow_fork_pages(tuple(self._layers),
+                                           src_a, dst_a))
+        self.free([src])
+        self.cow_copies += 1
 
     # -- arena plumbing ---------------------------------------------------
     def layers(self) -> tuple:
@@ -130,6 +342,12 @@ class PagedKVPool:
         return {"pages_free": self.pages_free,
                 "pages_used": self.pages_used,
                 "pages_total": self.num_pages,
+                "pages_cached": len(self._cached),
                 "page_size": self.page_size,
                 "high_water_used": self.high_water_used,
+                "shared_pages": self.shared_pages,
+                "prefix_hits": self.prefix_hits,
+                "prefix_lookups": self.prefix_lookups,
+                "cow_copies": self.cow_copies,
+                "alloc_total": self.alloc_total,
                 "kv_quant": self.kv_quant}

@@ -20,6 +20,26 @@ Composition (each piece usable alone):
   token) and ``decode_tick`` (the packed slot set, one token per active
   sequence, per-slot positions — inactive slots ride along masked to the
   pool's trash page, so the program never re-specializes on occupancy);
+* **speculative decoding** (``spec_k > 0``): a small draft model over the
+  shared base proposes k greedy tokens per slot and ONE jitted program per
+  tick both drafts and verifies — the draft scan rides its own page arenas
+  (same block tables, so pages stay interchangeable) and the base
+  verification is a single (k+1)-wide multi-position read over the main
+  arenas; accept/reject resolves as an in-program per-row gather, so the
+  tick stays one dispatch and emits up to k tokens per slot. Greedy
+  emission is token-for-token identical to non-speculative greedy decode
+  for ANY draft (the verifier's argmax corrects the first divergence), so
+  acceptance rate only moves THROUGHPUT, never output;
+* **copy-on-write prefix caching** (``prefix_cache``): admission asks the
+  pool for pages an identical earlier prompt prefix already filled
+  (refcounted sharing + token-hash prefix index, ``engine.kv_cache``),
+  prefill skips the resident rows, and the one shareable page a request
+  can ever write — the frontier page holding its prompt tail — is forked
+  onto a page reserved at admission (``ops.paged_attention.
+  cow_fork_pages``) right before its first divergent write. Hot system
+  prompts cost ~0 fresh pages per request; shared decode is bit-identical
+  to unshared because shared rows are the original writer's bits, re-read
+  not re-written;
 * admission control is SLO-aware: hard queue-depth and free-page
   watermarks reject at submit time, and an EMA of queue wait (the
   ``GoodputMonitor`` hysteresis pattern) sheds new work while the backlog
@@ -58,8 +78,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_dist.engine.generate import (_quantize_for_decode, _refuse_wo_tree,
-                                      _sample)
-from tpu_dist.engine.kv_cache import PagedKVPool
+                                      _sample, prepare_draft)
+from tpu_dist.engine.kv_cache import PagedKVPool, PrefixMatch
+from tpu_dist.ops.paged_attention import cow_fork_pages
 
 
 @dataclass
@@ -124,6 +145,8 @@ class ServeConfig:
     slo_alpha: float = 0.5
     slo_min_samples: int = 2
     kv_event_every: int = 0      # ticks between kv_cache events (0 = final)
+    spec_k: int = 0              # draft tokens per tick (0 = plain decode)
+    prefix_cache: bool = False   # CoW prefix sharing across requests
 
 
 @dataclass
@@ -140,6 +163,10 @@ class _Slot:
     first_token_ts: float = 0.0
     finish_ts: float = 0.0
     done: bool = False
+    # copy-on-write: (bt_slot, src_page, dst_page) of a SHARED frontier
+    # page this sequence will write into — forked right before its first
+    # decode write (engine._resolve_cow), None once private
+    cow_pending: Optional[Tuple[int, int, int]] = None
 
 
 def _default_buckets(max_len: int) -> Tuple[int, ...]:
@@ -166,14 +193,23 @@ def _prefill_program(model, temperature, top_k, top_p):
     # every call would copy every layer's whole page arena — per admitted
     # prompt, in the feature that exists to keep KV HBM tight
     @partial(jax.jit, donate_argnums=(1,))
-    def prefill(params, layers, block_table, length, prompt, rng):
+    def prefill(params, layers, block_table, length, shared_len, prompt,
+                rng):
         # block_table (1, max_pages) i32, length () i32, prompt (1, bucket):
         # causal self-attention over the padded prompt (positions >= length
         # influence nothing earlier), pages written for the live prefix,
-        # first token sampled from the last LIVE row's logits
+        # first token sampled from the last LIVE row's logits. Rows below
+        # ``shared_len`` sit on pages SHARED with an earlier identical
+        # prefix (prefix caching): already resident, so the write mask
+        # skips them — rewriting could drift bits across prefill buckets
+        # and would race the other holders' reads. shared_len is traced
+        # (0 when nothing is shared), so sharing never re-specializes.
+        valid = (jnp.arange(prompt.shape[1], dtype=jnp.int32)[None, :]
+                 >= jnp.asarray(shared_len, jnp.int32))
         paged = {"layers": layers, "block_tables": block_table,
                  "positions": jnp.zeros((1,), jnp.int32),
-                 "lengths": jnp.asarray(length, jnp.int32)[None]}
+                 "lengths": jnp.asarray(length, jnp.int32)[None],
+                 "valid": valid}
         logits, new_layers = model.apply(
             {"params": params}, prompt, train=False,
             paged=paged, paged_prefill=True)
@@ -208,6 +244,101 @@ def _tick_program(model, temperature, top_k, top_p):
     return tick
 
 
+@lru_cache(maxsize=32)
+def _draft_prefill_program(draft_model):
+    # the draft's prompt pass: writes the DRAFT arenas' prompt rows through
+    # the same block table the base prefill used (the pools share page
+    # indices) and discards the logits — the first emitted token is the
+    # base's, sampled by _prefill_program
+    @partial(jax.jit, donate_argnums=(1,))
+    def prefill(params, layers, block_table, length, shared_len, prompt):
+        valid = (jnp.arange(prompt.shape[1], dtype=jnp.int32)[None, :]
+                 >= jnp.asarray(shared_len, jnp.int32))
+        paged = {"layers": layers, "block_tables": block_table,
+                 "positions": jnp.zeros((1,), jnp.int32),
+                 "lengths": jnp.asarray(length, jnp.int32)[None],
+                 "valid": valid}
+        _, new_layers = draft_model.apply(
+            {"params": params}, prompt, train=False,
+            paged=paged, paged_prefill=True)
+        return new_layers
+
+    return prefill
+
+
+@lru_cache(maxsize=32)
+def _spec_tick_program(model, draft_model, k):
+    # The speculative tick: k greedy draft steps (a lax.scan over the draft
+    # arenas, one token per step) + ONE (k+1)-wide base verification over
+    # the main arenas + the accept/reject gather — all inside a single
+    # jitted dispatch, so speculation never adds host round-trips.
+    #
+    # Greedy emission rule (the bit-parity invariant): with drafts d_1..d_k
+    # and base argmaxes g_0..g_k at offsets 0..k, let ``a`` be the length
+    # of the longest prefix with d_i == g_{i-1}. Emit d_1..d_a plus the
+    # correction g_a when a < k (a+1 tokens — the correction IS what
+    # non-speculative greedy would have emitted next), and exactly d_1..d_k
+    # when a == k (k tokens, NO bonus token: g_k's source row is the k-th
+    # draft's KV, which the DRAFT arenas don't hold yet — emitting it would
+    # break the "draft rows cover 0..position-1" invariant the next tick's
+    # scan relies on). Either way every emitted token equals the base
+    # model's greedy continuation, for ANY draft — acceptance moves
+    # throughput, never output.
+    #
+    # Stale-row discipline: both pools' arenas accumulate speculative rows
+    # past the accepted frontier. They are invisible (per-row causal
+    # horizon) and the next tick overwrites them in position order before
+    # any read, so rejection needs NO rollback work — the block table and
+    # position simply don't advance past the accepted count.
+    @partial(jax.jit, donate_argnums=(2, 3))
+    def tick(params, draft_params, layers, draft_layers, block_tables,
+             tokens, positions, caps):
+        b = tokens.shape[0]
+
+        def draft_step(carry, _):
+            dlayers, tok, pos = carry
+            # a draft can overrun a short request's allocated rows; the
+            # cap mask routes those writes to the trash page (an unmasked
+            # overrun would CLAMP into the sequence's last live page)
+            paged = {"layers": dlayers, "block_tables": block_tables,
+                     "positions": pos, "lengths": pos + 1,
+                     "valid": (pos < caps)[:, None]}
+            logits, new_dlayers = draft_model.apply(
+                {"params": draft_params}, tok[:, None], train=False,
+                pos_offset=pos, paged=paged)
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            return (new_dlayers, nxt, pos + 1), nxt
+
+        (draft_layers, _, _), drafts = jax.lax.scan(
+            draft_step, (draft_layers, tokens, positions), None, length=k)
+        drafts = jnp.swapaxes(drafts, 0, 1)              # (B, k)
+
+        # one multi-position verify: row b carries queries for [t0, d1..dk]
+        # at positions pos..pos+k, writing all their K/V rows and reading
+        # each at its own causal horizon (ops.paged_attention)
+        ver = jnp.concatenate([tokens[:, None], drafts], axis=1)  # (B, k+1)
+        write_pos = positions[:, None] + jnp.arange(k + 1,
+                                                    dtype=jnp.int32)[None, :]
+        paged = {"layers": layers, "block_tables": block_tables,
+                 "positions": positions, "lengths": positions + k + 1,
+                 "valid": write_pos < caps[:, None]}
+        logits, new_layers = model.apply(
+            {"params": params}, ver, train=False,
+            pos_offset=positions, paged=paged)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
+
+        # longest accepted prefix, resolved per row with no host trip
+        matches = (drafts == greedy[:, :k]).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)       # (B,)
+        emit_n = jnp.minimum(a + 1, k)
+        corr = jnp.take_along_axis(greedy, a[:, None], axis=1)  # (B, 1)
+        idx = jnp.arange(k, dtype=jnp.int32)[None, :]
+        emitted = jnp.where(idx < a[:, None], drafts, corr)     # (B, k)
+        return emitted, emit_n, new_layers, draft_layers
+
+    return tick
+
+
 class ServeEngine:
     """The continuous-batching scheduler (module docstring has the tour).
 
@@ -218,7 +349,8 @@ class ServeEngine:
     """
 
     def __init__(self, model, params, config: Optional[ServeConfig] = None,
-                 *, ledger=None, now_fn: Callable[[], float] = time.monotonic,
+                 *, draft_model=None, draft_params=None, ledger=None,
+                 now_fn: Callable[[], float] = time.monotonic,
                  rng: Optional[jax.Array] = None):
         config = config if config is not None else ServeConfig()
         if getattr(model, "num_experts", 0):
@@ -241,6 +373,40 @@ class ServeEngine:
             model.num_heads, head_dim, dtype=model.dtype,
             kv_quant=cfg.kv_quant, read=cfg.attn_read)
         self.max_pages_per_seq = self.pool.pages_needed(self.max_len)
+        # speculative decoding: a draft proposes cfg.spec_k tokens per tick
+        # over its OWN arenas (a second pool, same page geometry + indices,
+        # so it rides the SAME block tables and the base pool's allocator
+        # is the single source of truth for page ownership)
+        self.draft_model = self.draft_params = self.draft_pool = None
+        if cfg.spec_k > 0:
+            if cfg.temperature > 0.0:
+                raise ValueError(
+                    "speculative decoding serves greedy verification only "
+                    "(spec_k > 0 needs temperature == 0): sampled "
+                    "acceptance is a different estimator with different "
+                    "output distribution guarantees")
+            if draft_model is None:
+                # self-speculation: the base drafts for itself (useful as a
+                # default and as the acceptance upper bound — the draft
+                # arenas still diverge numerically from the multi-position
+                # verify, so acceptance is high, not trivially 1.0)
+                self.draft_model, self.draft_params = self.model, self.params
+            else:
+                self.draft_model, self.draft_params = prepare_draft(
+                    self.model, draft_model, draft_params, cfg.quant)
+            d_head = (self.draft_model.d_model
+                      // self.draft_model.num_heads)
+            # draft reads stay on the exact path: the flash kernel is a
+            # bandwidth optimization for the big base arenas; the draft's
+            # are small by construction
+            self.draft_pool = PagedKVPool(
+                self.draft_model.num_layers, cfg.num_pages, cfg.page_size,
+                self.draft_model.num_heads, d_head,
+                dtype=self.draft_model.dtype, kv_quant=cfg.kv_quant,
+                read="exact")
+        elif draft_model is not None:
+            raise ValueError("draft_model given but cfg.spec_k == 0: set "
+                             "spec_k to the draft window size")
         # max_len always terminates the bucket ladder: a custom list that
         # stops short of a legal prompt must widen to max_len, not crash
         # the admit (and leak its granted pages) on a missing bucket
@@ -257,6 +423,14 @@ class ServeEngine:
         self.completed = 0
         self.rejected = 0
         self.prefills = 0
+        # speculative accounting: emitted tokens vs active-slot tick
+        # opportunities — accepted_per_tick = spec_emitted/spec_slot_ticks
+        # (identically 1.0 for plain decode; > 1.0 is speculation's win)
+        self.spec_emitted = 0
+        self.spec_slot_ticks = 0
+        # prefix-cache accounting: prompt pages needed vs served shared
+        self.prompt_pages = 0
+        self.shared_prompt_pages = 0
         self._occupancy_sum = 0.0
         self._wait_ema: Optional[float] = None
         self._wait_samples = 0
@@ -496,38 +670,80 @@ class ServeEngine:
             req, enq_ts = self.queue[0]
             prompt = np.asarray(req.prompt, np.int32).reshape(-1)
             total = prompt.size + req.max_new_tokens
-            pages = self.pool.alloc(self.pool.pages_needed(total))
-            if pages is None:
+            total_slots = self.pool.pages_needed(total)
+            match = (self.pool.share_prefix(prompt)
+                     if self.cfg.prefix_cache else None)
+            # fresh pages: everything past the FULL-page hits. A frontier
+            # (partial-page) hit replaces one fresh prompt page but
+            # reserves one fresh page as its copy-on-write destination —
+            # reserving at admission means the later fork can never fail,
+            # so the net fresh cost is total_slots - full either way.
+            fresh = self.pool.alloc(
+                total_slots - (match.full if match is not None else 0))
+            if fresh is None:
+                if match is not None:
+                    self.pool.unshare(match)
                 break  # pool pressure: leave it queued, decode on
             self.queue.popleft()
             now = self._now()
             self._observe_wait(now - enq_ts)
-            self._prefill(i, req, prompt, pages, enq_ts, now)
+            self._prefill(i, req, prompt, fresh, enq_ts, now, match)
 
-    def _prefill(self, slot_idx, req, prompt, pages, enq_ts, start_ts):
+    def _prefill(self, slot_idx, req, prompt, fresh, enq_ts, start_ts,
+                 match: Optional[PrefixMatch] = None):
         p = prompt.size
         bucket = next(b for b in self.buckets if b >= p)
+        shared = list(match.pages) if match is not None else []
+        shared_len = match.cov if match is not None else 0
+        cow = None
+        if match is not None and match.partial:
+            # the block table reads through the SHARED frontier page at
+            # slot match.full; the last fresh page is its reserved CoW
+            # destination, forked right before this sequence's first
+            # decode write (_resolve_cow)
+            cow = (match.full, shared[-1], fresh[-1])
+            bt_pages = shared + fresh[:-1]
+        else:
+            bt_pages = shared + fresh
         bt = np.full((self.max_pages_per_seq,), self.pool.num_pages,
                      np.int32)                       # unassigned -> trash
-        bt[:len(pages)] = pages
+        bt[:len(bt_pages)] = bt_pages
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :p] = prompt
         program = _prefill_program(self.model, self.cfg.temperature,
                                    self.cfg.top_k, self.cfg.top_p)
         tok, new_layers, self._rng = program(
             self.params, self.pool.layers(), jnp.asarray(bt[None]),
-            jnp.int32(p), jnp.asarray(padded), self._rng)
+            jnp.int32(p), jnp.int32(shared_len), jnp.asarray(padded),
+            self._rng)
         self.pool.adopt(new_layers)
+        if self.draft_pool is not None:
+            # the draft's prompt rows, through the same block table (the
+            # pools share page indices); shared rows were written by the
+            # earlier prefix owner's draft prefill, so the mask matches
+            dprog = _draft_prefill_program(self.draft_model)
+            self.draft_pool.adopt(dprog(
+                self.draft_params, self.draft_pool.layers(),
+                jnp.asarray(bt[None]), jnp.int32(p), jnp.int32(shared_len),
+                jnp.asarray(padded)))
+        if self.cfg.prefix_cache:
+            # index this prompt's freshly-written pages for future sharers
+            # (shared slots are already indexed by their original writer)
+            self.pool.register_prefix(prompt, bt_pages,
+                                      skip_slots=len(shared))
+            self.prompt_pages += self.pool.pages_needed(p)
+            self.shared_prompt_pages += len(shared)
         self.prefills += 1
         # the scheduler IS the drain boundary: the first token decides
         # done/eos and the TTFT stamp before the next iteration
         # distlint: disable=DL002 -- iteration-level scheduling syncs once per admit by design
         tok = int(jax.device_get(tok))
         now = self._now()
-        slot = _Slot(req=req, pages=pages, block_table=bt,
+        slot = _Slot(req=req, pages=shared + fresh, block_table=bt,
                      buf=np.zeros((p + req.max_new_tokens,), np.int32),
                      prompt_len=p, admit_ts=enq_ts, start_ts=start_ts,
-                     position=p, generated=1, first_token_ts=now)
+                     position=p, generated=1, first_token_ts=now,
+                     cow_pending=cow)
         slot.buf[:p] = prompt
         slot.buf[p] = tok
         if (slot.generated >= req.max_new_tokens
@@ -536,11 +752,34 @@ class ServeEngine:
             slot.finish_ts = now
         self.slots[slot_idx] = slot
 
+    def _resolve_cow(self, active) -> None:
+        """Fork every pending shared frontier page before this tick's
+        writes: each forking sequence gets the page's bits duplicated onto
+        its admission-reserved destination (both pools when speculating —
+        the arenas mirror page indices) and swaps its block-table entry;
+        the other holders keep reading the original page untouched."""
+        for _i, s in active:
+            if s.cow_pending is None:
+                continue
+            bt_slot, src, dst = s.cow_pending
+            self.pool.fork_page(src, dst)   # copies arenas, drops our src ref
+            if self.draft_pool is not None:
+                src_a = jnp.asarray([src], jnp.int32)
+                dst_a = jnp.asarray([dst], jnp.int32)
+                self.draft_pool.adopt(cow_fork_pages(
+                    self.draft_pool.layers(), src_a, dst_a))
+            s.block_table[bt_slot] = dst
+            s.pages.remove(src)
+            s.cow_pending = None
+
     def _tick(self) -> None:
         active = [(i, s) for i, s in enumerate(self.slots)
                   if s is not None and not s.done]
         if not active:
             return
+        self._resolve_cow(active)
+        if self.cfg.spec_k > 0:
+            return self._tick_spec(active)
         n = len(self.slots)
         tokens = np.zeros((n,), np.int32)
         positions = np.zeros((n,), np.int32)
@@ -575,6 +814,51 @@ class ServeEngine:
         self.ticks += 1
         self._occupancy_sum += len(active) / max(len(self.slots), 1)
 
+    def _tick_spec(self, active) -> None:
+        """One speculative iteration: k draft proposals + one base verify
+        per active slot, all in one dispatch (_spec_tick_program), then
+        host-side emission with per-slot budget/eos truncation — the same
+        sync point the plain tick already pays, now worth up to k tokens."""
+        n = len(self.slots)
+        k = self.cfg.spec_k
+        tokens = np.zeros((n,), np.int32)
+        positions = np.zeros((n,), np.int32)
+        caps = np.zeros((n,), np.int32)
+        bts = np.full((n, self.max_pages_per_seq), self.pool.num_pages,
+                      np.int32)
+        for i, s in active:
+            tokens[i] = s.buf[s.prompt_len + s.generated - 1]
+            positions[i] = s.position
+            # the write-mask cap: rows past the allocation routed to trash
+            # (a draft window can overrun a nearly-done request)
+            caps[i] = s.prompt_len + s.req.max_new_tokens
+            bts[i] = s.block_table
+        program = _spec_tick_program(self.model, self.draft_model, k)
+        emitted, emit_n, new_layers, new_dlayers = program(
+            self.params, self.draft_params, self.pool.layers(),
+            self.draft_pool.layers(), jnp.asarray(bts),
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(caps))
+        self.pool.adopt(new_layers)
+        self.draft_pool.adopt(new_dlayers)
+        # distlint: disable=DL002 -- the per-tick sync is the scheduler's eviction/refill decision point
+        emitted, emit_n = map(np.asarray, jax.device_get((emitted, emit_n)))
+        now = self._now()
+        for i, s in active:
+            for j in range(int(emit_n[i])):
+                tok = int(emitted[i, j])
+                s.buf[s.prompt_len + s.generated] = tok
+                s.generated += 1
+                s.position += 1
+                self.spec_emitted += 1
+                if (s.generated >= s.req.max_new_tokens
+                        or tok == self.cfg.eos_id):
+                    s.done = True
+                    s.finish_ts = now
+                    break
+            self.spec_slot_ticks += 1
+        self.ticks += 1
+        self._occupancy_sum += len(active) / max(len(self.slots), 1)
+
     def _emit_kv_cache(self) -> None:
         if self.ledger is None:
             return
@@ -584,6 +868,11 @@ class ServeEngine:
                          active_seqs=sum(s is not None for s in self.slots),
                          pages_total=st["pages_total"],
                          high_water_used=st["high_water_used"],
+                         shared_pages=st["shared_pages"],
+                         cow_copies=st["cow_copies"],
+                         prefix_hits=st["prefix_hits"],
+                         spec_emitted=self.spec_emitted,
+                         spec_slot_ticks=self.spec_slot_ticks,
                          slots=len(self.slots), tick=self.ticks)
 
     # -- introspection ----------------------------------------------------
@@ -593,10 +882,41 @@ class ServeEngine:
         number that separates continuous from static batching."""
         return self._occupancy_sum / self.ticks if self.ticks else 0.0
 
+    @property
+    def accepted_per_tick(self) -> Optional[float]:
+        """Mean tokens emitted per active-slot tick — identically 1.0 for
+        plain decode (not tracked there: None), > 1.0 is speculation's
+        whole win. The serving-side analog of offline tok/s."""
+        if not self.spec_slot_ticks:
+            return None
+        return self.spec_emitted / self.spec_slot_ticks
+
+    @property
+    def prefix_hit_rate(self) -> Optional[float]:
+        """Share of prompt pages served from the prefix cache instead of
+        freshly written (None until a prefix-cached prompt is admitted)."""
+        if not self.prompt_pages:
+            return None
+        return self.shared_prompt_pages / self.prompt_pages
+
     def stats(self) -> dict:
+        apt = self.accepted_per_tick
+        phr = self.prefix_hit_rate
         return {"ticks": self.ticks, "completed": self.completed,
                 "rejected": self.rejected, "prefills": self.prefills,
                 "occupancy": round(self.occupancy, 6),
+                "spec_k": self.cfg.spec_k,
+                "spec_emitted": self.spec_emitted,
+                "spec_slot_ticks": self.spec_slot_ticks,
+                "accepted_per_tick": (None if apt is None
+                                      else round(apt, 6)),
+                "prompt_pages": self.prompt_pages,
+                "shared_prompt_pages": self.shared_prompt_pages,
+                "prefix_hit_rate": (None if phr is None
+                                    else round(phr, 6)),
+                "pages_per_request": (
+                    round(self.pool.alloc_total / self.completed, 6)
+                    if self.completed else None),
                 "queue_depth": len(self.queue),
                 "active_seqs": sum(s is not None for s in self.slots),
                 "wait_ema_s": self._wait_ema,

@@ -231,8 +231,8 @@ class TransformerLM(nn.Module):
                      if self.remat and paged is None else Block)
         new_layers = []
         ctx = (None if paged is None else
-               {k: paged[k] for k in ("block_tables", "positions",
-                                      "lengths")})
+               {k: paged.get(k) for k in ("block_tables", "positions",
+                                          "lengths", "valid")})
         for i in range(self.num_layers):
             blk = block_cls(self.num_heads, self.dtype, self.attn_fn,
                             self.quant, self.tp_impl, name=f"block{i}")

@@ -80,8 +80,11 @@ EVENT_SCHEMA = {
     "request": ("rid", "tokens", "queue_wait_s", "admit_ts",
                 "first_token_ts", "finish_ts"),
     # paged KV pool pressure snapshot (engine.serve, periodic + final):
-    # high_water_used/slots/tick ride as extras
-    "kv_cache": ("pages_free", "pages_used", "active_seqs"),
+    # shared_pages/cow_copies/prefix_hits track cross-request prefix
+    # sharing, spec_emitted/spec_slot_ticks the speculative acceptance
+    # trend; high_water_used/slots/tick ride as extras
+    "kv_cache": ("pages_free", "pages_used", "active_seqs",
+                 "shared_pages", "cow_copies", "prefix_hits"),
     # numerical-health trip (obs.health sentry: non-finite grads/loss or a
     # loss spike); action records what the policy did (record|skip|halt)
     "health": ("step", "kind", "policy", "action", "value"),

@@ -23,7 +23,17 @@ in ``engine.kv_cache``, the scheduler in ``engine.serve``):
   the model's own ``attn_fn`` (+ page writes); the decode tick writes one
   row and attends over the gathered pages with PER-ROW positions — the
   continuous-batching difference from the flax cache, whose scalar
-  ``cache_index`` forces every batch row to the same position.
+  ``cache_index`` forces every batch row to the same position. The same
+  non-prefill path generalizes to Lq > 1 as the speculative-decoding
+  VERIFY read: row ``b`` carries ``Lq`` queries at positions
+  ``pos[b]..pos[b]+Lq-1`` (the last real token plus the draft proposals),
+  writes all their K/V rows through the block table, and attends each
+  local query at its own causal horizon — one dispatch validates a whole
+  draft window.
+* :func:`cow_fork_pages` — the copy-on-write fork behind cross-request
+  prefix sharing (``engine.kv_cache``): gather the shared source pages,
+  scatter them onto freshly-granted destinations, so the writer diverges
+  on its own copy and the other holders keep reading the original bits.
 * int8 arenas: pages hold int8 values + one fp32 scale per (page-slot, head)
   row — the ``ops.flash_attention.quantize_kv`` layout, quantized by
   ``ops.quant.quantize_int8`` itself so the rounding convention can never
@@ -141,6 +151,43 @@ def gather_pages(arena, block_table):
     g = arena[block_table]                       # (B, P, page_size, ...)
     b, p, s = g.shape[:3]
     return g.reshape((b, p * s) + g.shape[3:])
+
+
+def _fork_arena(arena, src_pages, dst_pages):
+    """Whole-page gather-then-scatter: arena[dst] <- arena[src]."""
+    return arena.at[dst_pages].set(arena[src_pages])
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def cow_fork_pages(layers, src_pages, dst_pages):
+    """Copy-on-write fork: duplicate ``src_pages`` onto ``dst_pages`` in
+    every layer's arenas (K, V and, for int8 arenas, their scales).
+
+    The prefix-sharing allocator (``engine.kv_cache``) hands a new request
+    the SAME physical pages another sequence's identical prompt prefix
+    already occupies; the first write that would diverge (the frontier
+    page's first generated token) must land on a private copy instead.
+    This is that fork as one jitted gather-then-scatter over all layers —
+    whole pages are copied (stale rows beyond the shared prefix ride
+    along harmlessly: the per-row causal mask hides them until the new
+    owner overwrites them in position order), and the arenas are DONATED
+    like every other page program so a fork never duplicates an arena.
+
+    ``src_pages``/``dst_pages`` are (n,) i32; forks are rare host-decided
+    events (at most one frontier page per admitted request), so n is tiny
+    and jit re-specialization per n is immaterial.
+    """
+    out = []
+    for layer in layers:
+        fields = {"k": _fork_arena(layer.k, src_pages, dst_pages),
+                  "v": _fork_arena(layer.v, src_pages, dst_pages)}
+        if layer.k_scale is not None:
+            fields["k_scale"] = _fork_arena(layer.k_scale, src_pages,
+                                            dst_pages)
+            fields["v_scale"] = _fork_arena(layer.v_scale, src_pages,
+                                            dst_pages)
+        out.append(layer.replace(**fields))
+    return tuple(out)
 
 
 # ---------------------------------------------------------------------------
@@ -306,12 +353,20 @@ def paged_attend(q, k, v, paged: dict, *, prefill: bool, attn_fn, dtype):
 
     ``paged`` carries the layer's arenas plus the shared context:
     ``{"layer": PagedLayer, "block_tables": (B, max_pages) i32,
-    "positions": (B,) i32, "lengths": (B,) i32}``. Prefill (``prefill=
-    True``): the queries attend within the prompt through the model's own
-    ``attn_fn`` (plain causal self-attention — nothing to read back), and
-    all ``lengths[b]`` leading K/V rows are written to the pages; the tick
-    (``prefill=False``, Lq == 1) writes one row at ``positions[b]`` and
-    attends over the gathered pages with per-row positions.
+    "positions": (B,) i32, "lengths": (B,) i32}`` plus an optional
+    ``"valid"`` (B, Lq) bool write mask. Prefill (``prefill=True``): the
+    queries attend within the prompt through the model's own ``attn_fn``
+    (plain causal self-attention — nothing to read back), and the leading
+    ``lengths[b]`` K/V rows are written to the pages — unless ``valid``
+    narrows them further (prefix caching skips the rows whose pages are
+    SHARED with an identical earlier prompt: rewriting them would race
+    the frontier fork and the bits are already there). The tick
+    (``prefill=False``) writes Lq rows at ``positions[b]..positions[b]+
+    Lq-1`` and attends each local query at its own per-row position —
+    Lq == 1 is the classic decode tick, Lq > 1 the speculative-decoding
+    verify window (``valid`` masks rows past a sequence's token cap to
+    the trash page: a draft can overrun the end of a short request, and
+    an unmasked overrun would clamp into a LIVE page).
 
     Returns ``(out, new_layer)`` — the functionally-updated arenas thread
     back out through the model call.
@@ -328,8 +383,11 @@ def paged_attend(q, k, v, paged: dict, *, prefill: bool, attn_fn, dtype):
                                      (b, lq))
         valid = write_pos < lengths[:, None]
     else:
-        write_pos = positions[:, None].astype(jnp.int32)        # (B, 1)
-        valid = jnp.ones((b, 1), dtype=bool)
+        write_pos = (positions[:, None].astype(jnp.int32)
+                     + jnp.arange(lq, dtype=jnp.int32)[None, :])  # (B, Lq)
+        valid = jnp.ones((b, lq), dtype=bool)
+    if paged.get("valid") is not None:
+        valid = valid & paged["valid"]
 
     if layer.quant == "int8":
         kq, ks = _quantize_rows(k)
@@ -351,7 +409,10 @@ def paged_attend(q, k, v, paged: dict, *, prefill: bool, attn_fn, dtype):
         # training contraction, so flash/blockwise plug-ins keep working
         return attn_fn(q, k, v), new_layer
 
-    if layer.quant == "int8" and layer.read == "flash":
+    if layer.quant == "int8" and layer.read == "flash" and lq == 1:
+        # the Pallas kernel is one-query-per-row (the decode tick); the
+        # Lq > 1 verify window reads through the exact dequant path below
+        # — same math, and verify dispatches are 1-in-k ticks by design
         out = int8kv_paged_flash_attention_fn()(
             q, gather_pages(new_layer.k, bt),
             gather_pages(new_layer.k_scale, bt),
