@@ -173,6 +173,49 @@ finally:
 print("plan IR + tuner gate: OK (no jax, deterministic)")
 EOF
 
+# Request-observatory gate (round 17), jax-free BY CONSTRUCTION: the
+# span model (obs.reqtrace) and the reading side (tools/request_report)
+# must run on a bare login/CI host, and the report must be DETERMINISTIC
+# — same ledger bytes, same report bytes. Built twice from the canned
+# two-host fixture (rid 5 shed on host 0, re-admitted on host 1) with
+# fresh loads, then the invariants the fixture encodes are asserted: one
+# cross-host trace, coverage 1.0 with the sum-check green, and every slo
+# breach holding >= 1 exemplar. A stray `import jax` creeping into
+# obs.reqtrace / sim.fleet / the report tool fails HERE.
+python - <<'EOF'
+import builtins, json
+
+_real = builtins.__import__
+def _guard(name, *a, **k):
+    if name == "jax" or name.startswith("jax."):
+        raise ImportError(f"reqtrace gate: jax import blocked ({name})")
+    return _real(name, *a, **k)
+builtins.__import__ = _guard
+
+from tools.request_report import render, requests_summary
+from tpu_dist.sim.fleet import FleetLedger
+
+FIX = "tests/fixtures/reqtrace"
+
+def build():
+    records = FleetLedger.discover(FIX).merged()
+    summary = requests_summary(records)
+    lines = []
+    render(summary, records, out=lines.append, waterfalls=5)
+    return summary, json.dumps(summary, default=str) + "\n".join(lines)
+
+summary, text1 = build()
+_, text2 = build()
+assert text1 == text2, "request report is not deterministic"
+assert summary["cross_host_traces"] == 1, summary
+ta = summary["tail_attribution"]
+assert ta["coverage"] == 1.0 and ta["sum_check"]["ok"], ta
+assert summary["slo_exemplars"], "fixture breach lost"
+assert all(b["exemplars"] for b in summary["slo_exemplars"]), \
+    "a breach resolved to no exemplar"
+print("reqtrace gate: OK (no jax, deterministic)")
+EOF
+
 # Advisory tier-1 budget creep warning (never fails the gate): conftest
 # writes each full-suite run's wall time + top-20 durations to
 # /tmp/tier1_durations.json (TPU_DIST_TIER1_DURATIONS overrides); the

@@ -31,6 +31,13 @@ def emit_fleet_badly(ledger):
     ledger.emit("fleet", hosts_live=3)               # missing ratio/breaches
 
 
+def emit_span_badly(ledger, ids):
+    # round 17: the request-trace span event is schema-checked like the
+    # rest — identity and interval must be explicit at the call site
+    ledger.emit("span", name="queue", rid=7)     # missing ids + interval
+    ledger.emit("span", **ids)                   # required fields in a splat
+
+
 def emit_plan_badly(ledger):
     # round 15: the step-plan events (tpu_dist.plan) are schema-checked
     ledger.emit("plan", source="plans.json")     # missing plan_hash/knobs

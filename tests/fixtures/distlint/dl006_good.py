@@ -55,6 +55,20 @@ def emit_fleet_well(ledger):
                 final=True)
 
 
+def emit_span_well(ledger, tid, sid, attrs):
+    # round 17: the request-trace span event (obs.reqtrace writes ids,
+    # engine.serve / engine.kv_cache / sim.worker emit) — the seven
+    # identity+interval fields are required; per-phase detail (bucket,
+    # ticks, reason, ...) and the tracer's job/attempt/host stamp splat
+    # as extras, exactly the serve.py call shape
+    ledger.emit("span", trace_id=tid, span_id=sid, parent_id=None,
+                name="queue", rid=7, start=1.25, end=1.5,
+                queue_depth=3, tenant="t0", **attrs)
+    ledger.emit("span", trace_id=tid, span_id=sid, parent_id=sid,
+                name="decode", rid=7, start=1.5, end=2.0,
+                ticks=8, tokens=8, spec_drafted=0, **attrs)
+
+
 def emit_plan_well(ledger):
     # round 15: the step-plan events (tpu_dist.plan) — the engines' plan
     # stamp and tools/tune.py's per-device-kind search record

@@ -464,6 +464,30 @@ def test_fleet_ci_scenario_acceptance(tmp_path):
         assert t["queue_wait_s"]["p50"] is not None
     assert report["serving"]["completed"] > 0
 
+    # -- request observatory: attribution sums, breaches have evidence --
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "request_report.py"),
+         out_dir, "--json"], capture_output=True, text=True, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr
+    req = json.loads(proc.stdout)
+    assert req["completed_requests"] > 0
+    ta = req["tail_attribution"]
+    # the per-request sum-check: queue + prefill + decode + residue is an
+    # identity against measured latency, and residue stays inside the
+    # rounding tolerance for EVERY completed request
+    assert ta["sum_check"]["ok"], ta["sum_check"]
+    for row in req["per_request"]:
+        assert row["latency_s"] == pytest.approx(
+            row["queue_s"] + row["prefill_s"] + row["decode_s"]
+            + row["residue_s"], abs=1e-6)
+    # every slo breach resolves to >= 1 concrete exemplar trace — a
+    # breach that points at nothing is a report bug, not a gap
+    assert len(req["slo_exemplars"]) == report["slo_breaches"]
+    for breach in req["slo_exemplars"]:
+        assert len(breach["exemplars"]) >= 1, breach
+    # the fleet report stitched the same traces the request report read
+    assert len(report["traces"]) == req["traces"] > 0
+
     # -- the runner's own artifacts -------------------------------------
     assert report_inline["restart_classes"] == report["restart_classes"]
     assert report_inline["supervisors"]["0"]["status"] == "clean"

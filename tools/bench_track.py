@@ -118,6 +118,13 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
             # cache's number); pre-spec history abstains like the rest
             "serving_apt": serving.get("accepted_per_tick"),
             "serving_ppr": serving.get("pages_per_request"),
+            # round 17+: attribution coverage from the request spans —
+            # the share of completed-request latency the queue/prefill/
+            # decode spans account for (1.0 on any ledger that lost no
+            # span); pre-span history abstains like the rest
+            "serving_cov": (serving.get("tail_attribution") or {}).get(
+                "coverage") if isinstance(
+                serving.get("tail_attribution"), dict) else None,
             "fleet_goodput": fleet.get("goodput_ratio"),
             "round": rnd,
             "file": os.path.basename(path),
@@ -191,6 +198,16 @@ def track(points: List[dict], threshold_pct: float,
                          and ppr_best > 0
                          and (ppr_latest - ppr_best) / ppr_best * 100.0
                          > threshold_pct)
+        # attribution coverage (round 17+): higher is better (1.0 means
+        # every completed request's latency fully decomposes into spans);
+        # a drop means the engine started losing span windows
+        prior_cov = [p["serving_cov"] for p in prior
+                     if p.get("serving_cov") is not None]
+        cov_best = max(prior_cov, default=None)
+        cov_latest = latest.get("serving_cov")
+        cov_regressed = (cov_best is not None and cov_latest is not None
+                         and (cov_best - cov_latest) / cov_best * 100.0
+                         > threshold_pct)
         # fleet goodput ratio (tpu_dist.sim): higher is better, judged
         # against the best prior point CARRYING a fleet block — pre-fleet
         # history abstains, exactly the data_s/serving convention
@@ -225,12 +242,15 @@ def track(points: List[dict], threshold_pct: float,
             "pages_latest": ppr_latest,
             "pages_best_prior": ppr_best,
             "pages_regressed": ppr_regressed,
+            "coverage_latest": cov_latest,
+            "coverage_best_prior": cov_best,
+            "coverage_regressed": cov_regressed,
             "fleet_latest": fleet_latest,
             "fleet_best_prior": fleet_best,
             "fleet_regressed": fleet_regressed,
         }
         if (regressed or data_regressed or srv_regressed or apt_regressed
-                or ppr_regressed or fleet_regressed):
+                or ppr_regressed or cov_regressed or fleet_regressed):
             report["ok"] = False
     return report
 
@@ -295,6 +315,18 @@ def render(report: dict, out=print) -> None:
             else:
                 out(f"  -> pages: {m['pages_latest']:.4f} fresh "
                     "pages/request (no prior prefix-cache history; "
+                    "nothing to judge)")
+        if m.get("coverage_latest") is not None:
+            if m.get("coverage_best_prior") is not None:
+                verdict = ("COVERAGE REGRESSED"
+                           if m["coverage_regressed"] else "ok")
+                out(f"  -> attribution {verdict}: coverage "
+                    f"{m['coverage_latest']:.4f} vs best prior "
+                    f"{m['coverage_best_prior']:.4f} (threshold "
+                    f"{report['threshold_pct']:g}%)")
+            else:
+                out(f"  -> attribution: coverage "
+                    f"{m['coverage_latest']:.4f} (no prior span history; "
                     "nothing to judge)")
         if m.get("fleet_latest") is not None:
             if m.get("fleet_best_prior") is not None:
@@ -362,7 +394,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         bad = [k for k, m in report["metrics"].items()
                if m["regressed"] or m.get("data_s_regressed")
                or m.get("serving_regressed") or m.get("accepted_regressed")
-               or m.get("pages_regressed") or m.get("fleet_regressed")]
+               or m.get("pages_regressed") or m.get("coverage_regressed")
+               or m.get("fleet_regressed")]
         print(f"bench_track: REGRESSION in {bad}", file=sys.stderr)
         return 1
     return 0

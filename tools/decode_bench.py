@@ -104,7 +104,11 @@ def replay_serving_trace(args, model, params, ledger=None):
     machine-independent."""
     import numpy as np
 
+    from tools.request_report import (requests_summary, slowest_traces,
+                                      waterfall_lines)
     from tpu_dist.engine.serve import ServeConfig, ServeEngine
+    from tpu_dist.obs import reqtrace
+    from tpu_dist.obs.ledger import Ledger
 
     rng = np.random.default_rng(args.trace_seed)
     gaps = rng.exponential(1.0 / max(args.arrival_rate, 1e-9), args.trace)
@@ -142,6 +146,12 @@ def replay_serving_trace(args, model, params, ledger=None):
             kv_event_every=32), ledger=led)
 
     _drive_trace(make("continuous"), arrivals, prompts, outs)  # warm
+    # the continuous (headline) mode always runs with a span-capturing
+    # ledger: the engine's per-request spans (obs.reqtrace) feed the
+    # tail_attribution block and --waterfalls without needing --ledger
+    span_cap = []
+    cont_led = ledger if ledger is not None else Ledger(None)
+    cont_led.add_sink(span_cap.append)
     results = {}
     modes = [("continuous", True), ("drain", True)]
     if prefix_on:
@@ -150,7 +160,7 @@ def replay_serving_trace(args, model, params, ledger=None):
         modes.append(("no_prefix_cache", False))
     for refill, prefix_cache in modes:
         eng = make("continuous" if refill == "no_prefix_cache" else refill,
-                   led=ledger if refill == "continuous" else None,
+                   led=cont_led if refill == "continuous" else None,
                    prefix_cache=prefix_cache)
         comps, elapsed = _drive_trace(eng, arrivals, prompts, outs)
         ttft = [c.ttft_s for c in comps]
@@ -209,6 +219,29 @@ def replay_serving_trace(args, model, params, ledger=None):
     serving["static"] = results["drain"]
     if prefix_on:
         serving["no_prefix_cache"] = results["no_prefix_cache"]
+    # the request-observatory view of the continuous replay: the captured
+    # span stream is the same record shape tools/request_report.py reads
+    # off a ledger, so the headline carries per-request attribution
+    # (bench_track gates coverage) and --waterfalls renders the slowest
+    # requests' span trees
+    summary = requests_summary(span_cap)
+    ta = summary.get("tail_attribution")
+    serving["tail_attribution"] = ta
+    if ta:
+        print(f"serve[traces]: {summary['completed_requests']} request "
+              f"trace(s), coverage {ta['coverage']}, sum-check "
+              f"{'OK' if ta['sum_check']['ok'] else 'FAILED'} "
+              f"(max residue {ta['sum_check']['max_residue_s']:.6g}s)",
+              file=sys.stderr)
+    n_falls = getattr(args, "waterfalls", 0)
+    if n_falls > 0:
+        traces = reqtrace.traces(span_cap)
+        slow = slowest_traces(traces, n_falls)
+        print(f"serve[traces]: {len(slow)} slowest request waterfall(s):",
+              file=sys.stderr)
+        for tr in slow:
+            for line in waterfall_lines(tr):
+                print("  " + line, file=sys.stderr)
     return serving
 
 
@@ -258,6 +291,10 @@ def main():
                          "equal capacity; adds the 'serving' block to the "
                          "headline JSON (0 = off)")
     ap.add_argument("--trace-seed", type=int, default=0)
+    ap.add_argument("--waterfalls", type=int, default=0,
+                    help="after the trace replay, print this many slowest "
+                         "request waterfalls (span trees from "
+                         "obs.reqtrace) to stderr (0 = off)")
     ap.add_argument("--arrival-rate", type=float, default=1.0,
                     help="mean request arrivals per decode tick (Poisson)")
     ap.add_argument("--min-prompt", type=int, default=4)

@@ -98,6 +98,19 @@ def render(report: dict, out=print) -> None:
     if srv:
         out(f"serving totals: {srv.get('completed')} completed, "
             f"{srv.get('rejected')} rejected")
+    traces = report.get("traces") or {}
+    if traces:
+        cross = [t for t in traces.values() if len(t.get("hosts") or []) > 1]
+        out(f"request traces: {len(traces)} stitched, {len(cross)} "
+            f"cross-host, "
+            f"{sum(t.get('sheds') or 0 for t in traces.values())} shed "
+            f"span(s), "
+            f"{sum(t.get('readmits') or 0 for t in traces.values())} "
+            "readmit(s)")
+        for t in cross[:5]:
+            out(f"  rid {t['rid']}: hosts {t['hosts']}, {t['spans']} "
+                f"span(s), completed={t['completed']} — one trace_id, "
+                "N hosts (waterfalls: tools/request_report.py)")
     elas = report.get("elasticity") or []
     if elas:
         out(f"\nelasticity ({len(elas)} scale event(s), fleet clock):")

@@ -417,6 +417,24 @@ def _counter_trend(kv, num_key, den_key):
     return trend
 
 
+def requests_section(records, out=print):
+    """Per-request tracing rollup (obs.reqtrace ``span`` events): the
+    waterfall summary, the tail-latency attribution table with its
+    per-request sum-check, and the SLO-breach exemplar pointers — all
+    delegated to tools/request_report (the span model's reading side) so
+    this CLI and that one render the same math. None when the ledger
+    predates spans (pre-PR-17 history stays renderable)."""
+    if not any(r.get("event") == "span" for r in records):
+        return None
+    from tools.request_report import render as render_requests
+    from tools.request_report import requests_summary
+
+    summary = requests_summary(records)
+    out("")
+    render_requests(summary, records, out=out, waterfalls=1)
+    return summary
+
+
 def summarize(records, out=print):
     """Render the summary through ``out`` and return the machine-readable
     dict (--json prints it verbatim; the legacy count keys ride along)."""
@@ -599,6 +617,7 @@ def summarize(records, out=print):
 
     # serving-SLO view over decode events (generate / decode_bench)
     summary["decode"] = decode_section(records, out=out)
+    summary["requests"] = requests_section(records, out=out)
 
     if skews:
         worst = max(skews, key=lambda r: r["spread_s"])

@@ -60,6 +60,10 @@ from tpu_dist.obs.ledger import read_ledger  # noqa: E402
 TID_STEPS, TID_COMM, TID_PHASES, TID_ALERTS = 0, 1, 2, 3
 _TID_NAMES = {TID_STEPS: "steps", TID_COMM: "comm (overlaps device)",
               TID_PHASES: "phases", TID_ALERTS: "alerts"}
+# per-request lanes (obs.reqtrace span events): each traced rid gets its
+# own thread row from this base, so waterfalls render NEXT TO the step/
+# phase lanes of the process that served them
+TID_REQ_BASE = 16
 
 
 def discover_ledgers(path: str) -> list:
@@ -96,6 +100,18 @@ def _process_events(records: list, pid: int) -> list:
     for tid, tname in _TID_NAMES.items():
         ev.append({"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
                    "args": {"name": tname}})
+    # request lane assignment: one thread row per traced rid, in order of
+    # first appearance (deterministic — the ledger's emit order is)
+    req_tids: dict = {}
+
+    def _req_tid(rid) -> int:
+        tid = req_tids.get(rid)
+        if tid is None:
+            tid = TID_REQ_BASE + len(req_tids)
+            req_tids[rid] = tid
+            ev.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": f"request r{rid}"}})
+        return tid
 
     for r in records:
         kind, ts = r.get("event"), r.get("ts", t0)
@@ -136,6 +152,19 @@ def _process_events(records: list, pid: int) -> list:
                        "tid": TID_STEPS, "ts": us(ts) - dur * 1e6,
                        "dur": dur * 1e6,
                        "args": _args(r, ("tokens", "throughput", "cached"))})
+        elif kind == "span":
+            # span start/end are engine-clock; the wall emit ts anchors
+            # the slice's END (spans close at emit — same convention as
+            # the 'decode' slices above), so the lane lines up with the
+            # step rows without cross-clock arithmetic
+            dur = (r.get("end") or 0.0) - (r.get("start") or 0.0)
+            ev.append({"ph": "X", "name": r.get("name") or "span",
+                       "pid": pid, "tid": _req_tid(r.get("rid")),
+                       "ts": us(ts) - dur * 1e6, "dur": dur * 1e6,
+                       "args": _args(r, ("trace_id", "rid", "bucket",
+                                         "tokens", "ticks", "reason",
+                                         "pages_shared", "spec_drafted",
+                                         "ttft_s", "tenant"))})
         elif kind in ("eval", "ckpt", "compile", "run_start", "run_end"):
             ev.append({"ph": "i", "name": kind, "pid": pid,
                        "tid": TID_PHASES, "ts": us(ts), "s": "t",

@@ -163,6 +163,19 @@ class PagedKVPool:
         self.prefix_lookups = 0     # share_prefix calls
         self.cow_copies = 0         # frontier forks performed
         self.alloc_total = 0        # fresh pages granted (pages/request)
+        # request tracing (obs.reqtrace): bound by the serving engine so
+        # prefix hits and CoW forks surface as per-request detail spans;
+        # a standalone pool stays silent
+        self._tracer = None
+        self._now = None
+
+    def bind_trace(self, tracer, now_fn) -> None:
+        """Attach the engine's trace context: ``tracer`` derives span ids
+        (None disables), ``now_fn`` is the ENGINE clock — span timestamps
+        must live on the same axis as the scheduler's queue/prefill
+        spans, not this module's idea of time."""
+        self._tracer = tracer
+        self._now = now_fn
 
     # -- allocator --------------------------------------------------------
     @property
@@ -223,13 +236,16 @@ class PagedKVPool:
         return slots * self.pages_needed(max_total)
 
     # -- prefix index -----------------------------------------------------
-    def share_prefix(self, prompt: np.ndarray) -> PrefixMatch:
+    def share_prefix(self, prompt: np.ndarray,
+                     rid: Optional[int] = None) -> PrefixMatch:
         """Map the longest resident prefix of ``prompt`` onto shared
         pages: whole-page hits first (index walk by cumulative prefix
         hash), then one frontier page whose leading rows match the
         remaining tail. Bumps refcounts (un-parking cached pages) and
         returns a :class:`PrefixMatch`; ``unshare`` undoes it when the
-        admission cannot complete."""
+        admission cannot complete. ``rid`` attributes a hit to a request
+        trace (a ``prefix_hit`` detail span) when tracing is bound."""
+        t0 = self._now() if self._now is not None else 0.0
         self.prefix_lookups += 1
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         ps = self.page_size
@@ -261,6 +277,16 @@ class PagedKVPool:
         if pages:
             self.high_water_used = max(self.high_water_used,
                                        self.pages_used)
+        if pages and self._tracer is not None and rid is not None:
+            # a HIT is trace-worthy (it explains a cheap prefill); misses
+            # are the default and would only pad the ledger
+            tr = self._tracer
+            tid, sid, par = tr.ids(rid, "prefix_hit")
+            tr.ledger.emit("span", trace_id=tid, span_id=sid,
+                           parent_id=par, name="prefix_hit", rid=rid,
+                           start=round(t0, 6), end=round(self._now(), 6),
+                           pages=len(pages), full=full, partial=partial,
+                           cov=cov, **tr.attrs())
         return PrefixMatch(pages, full, partial, cov)
 
     def unshare(self, match: PrefixMatch) -> None:
@@ -314,19 +340,30 @@ class PagedKVPool:
             if not kids:
                 del self._children[parent]
 
-    def fork_page(self, src: int, dst: int) -> None:
+    def fork_page(self, src: int, dst: int,
+                  rid: Optional[int] = None) -> None:
         """Copy-on-write fork: duplicate ``src``'s rows onto the already-
         granted ``dst`` in every layer's arenas and drop one reference
         from ``src`` (the forking sequence's). The caller swaps its block
-        table entry; other holders keep reading ``src``."""
+        table entry; other holders keep reading ``src``. ``rid``
+        attributes the fork cost to a request trace (a ``cow_fork``
+        detail span) when tracing is bound."""
         from tpu_dist.ops.paged_attention import cow_fork_pages
 
+        t0 = self._now() if self._now is not None else 0.0
         src_a = jnp.asarray([src], jnp.int32)
         dst_a = jnp.asarray([dst], jnp.int32)
         self._layers = list(cow_fork_pages(tuple(self._layers),
                                            src_a, dst_a))
         self.free([src])
         self.cow_copies += 1
+        if self._tracer is not None and rid is not None:
+            tr = self._tracer
+            tid, sid, par = tr.ids(rid, "cow_fork")
+            tr.ledger.emit("span", trace_id=tid, span_id=sid,
+                           parent_id=par, name="cow_fork", rid=rid,
+                           start=round(t0, 6), end=round(self._now(), 6),
+                           src=src, dst=dst, **tr.attrs())
 
     # -- arena plumbing ---------------------------------------------------
     def layers(self) -> tuple:

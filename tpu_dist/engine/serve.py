@@ -80,6 +80,7 @@ import numpy as np
 from tpu_dist.engine.generate import (_quantize_for_decode, _refuse_wo_tree,
                                       _sample, prepare_draft)
 from tpu_dist.engine.kv_cache import PagedKVPool, PrefixMatch
+from tpu_dist.obs.reqtrace import RequestTracer
 from tpu_dist.ops.paged_attention import cow_fork_pages
 
 
@@ -147,6 +148,10 @@ class ServeConfig:
     kv_event_every: int = 0      # ticks between kv_cache events (0 = final)
     spec_k: int = 0              # draft tokens per tick (0 = plain decode)
     prefix_cache: bool = False   # CoW prefix sharing across requests
+    # request tracing: decode spans coalesce this many ticks per slot into
+    # one window span (per-token spans would dwarf the ledger; windows
+    # keep the waterfall readable AND tile first-token->finish exactly)
+    trace_window_ticks: int = 8
 
 
 @dataclass
@@ -167,6 +172,13 @@ class _Slot:
     # page this sequence will write into — forked right before its first
     # decode write (engine._resolve_cow), None once private
     cow_pending: Optional[Tuple[int, int, int]] = None
+    # request tracing: the open decode-window span (obs.reqtrace) — opens
+    # at the first token, closes every trace_window_ticks ticks and at
+    # finish, so the windows tile first-token->finish contiguously
+    win_start_ts: float = 0.0
+    win_ticks: int = 0
+    win_tokens: int = 0
+    win_drafted: int = 0
 
 
 def _default_buckets(max_len: int) -> Tuple[int, ...]:
@@ -350,6 +362,7 @@ class ServeEngine:
 
     def __init__(self, model, params, config: Optional[ServeConfig] = None,
                  *, draft_model=None, draft_params=None, ledger=None,
+                 tracer: Optional[RequestTracer] = None,
                  now_fn: Callable[[], float] = time.monotonic,
                  rng: Optional[jax.Array] = None):
         config = config if config is not None else ServeConfig()
@@ -418,6 +431,16 @@ class ServeEngine:
         self._now = now_fn
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.ledger = ledger
+        # request tracing (obs.reqtrace): a ledger implies spans — callers
+        # with a fleet identity (sim.worker) inject their own tracer so
+        # trace ids stitch across hosts; standalone serving defaults to a
+        # local single-job namespace
+        self.tracer = tracer
+        if self.tracer is None and ledger is not None:
+            self.tracer = RequestTracer(ledger, job_id="serve", attempt=0)
+        # the pool's prefix/CoW work happens inside admission — bind the
+        # trace context so hits and forks surface as detail spans
+        self.pool.bind_trace(self.tracer, self._now)
         # counters / SLO state
         self.ticks = 0
         self.completed = 0
@@ -480,7 +503,7 @@ class ServeEngine:
         self._emit_admit(req, now, True, None)
         return True
 
-    def _emit_admit(self, req, now, accepted, reason):
+    def _emit_admit(self, req, now, accepted, reason, enq_ts=None):
         if not accepted:
             self.rejected += 1
         if self.ledger is None:
@@ -490,6 +513,19 @@ class ServeEngine:
                          pages_free=self.pool.pages_free,
                          reason=reason, tenant=req.tenant,
                          ts_engine=round(now, 6))
+        if accepted or self.tracer is None:
+            return
+        # every rejection is a 'shed' span: zero-duration at the door
+        # (submit-time admission control), enq->now for a queued request
+        # shed by drain — the trace-side record that lets a re-admission
+        # on ANOTHER host stitch into the same trace_id
+        tr = self.tracer
+        tid, sid, par = tr.ids(req.rid, "shed")
+        tr.ledger.emit("span", trace_id=tid, span_id=sid, parent_id=par,
+                       name="shed", rid=req.rid,
+                       start=round(now if enq_ts is None else enq_ts, 6),
+                       end=round(now, 6), reason=reason,
+                       tenant=req.tenant, **tr.attrs())
 
     def _observe_wait(self, wait: float) -> None:
         a = self.cfg.slo_alpha
@@ -604,8 +640,10 @@ class ServeEngine:
         shed = list(self.queue)
         self.queue.clear()
         now = self._now()
-        for req, _enq_ts in shed:
-            self._emit_admit(req, now, False, "shed")
+        for req, enq_ts in shed:
+            # the shed span covers the request's whole queued life — the
+            # wait it paid before this host gave up on it
+            self._emit_admit(req, now, False, "shed", enq_ts=enq_ts)
         out: List[Completion] = []
         t0_ticks = self.ticks
         while any(s is not None for s in self.slots):
@@ -656,6 +694,21 @@ class ServeEngine:
                     prompt_len=comp.prompt_len,
                     tenant=slot.req.tenant,
                     ttft_s=round(comp.ttft_s, 6))
+            if self.tracer is not None:
+                # the root span: this (job, attempt)'s whole view of the
+                # request, admit->finish. Emitted at eviction, after every
+                # child — readers key the tree on ids, not emit order
+                tr = self.tracer
+                tid, sid, par = tr.root_ids(comp.rid)
+                tr.ledger.emit("span", trace_id=tid, span_id=sid,
+                               parent_id=par, name="request", rid=comp.rid,
+                               start=round(comp.admit_ts, 6),
+                               end=round(comp.finish_ts, 6),
+                               ttft_s=round(comp.ttft_s, 6),
+                               queue_wait_s=round(comp.queue_wait_s, 6),
+                               tokens=comp.n_generated,
+                               prompt_len=comp.prompt_len,
+                               tenant=slot.req.tenant, **tr.attrs())
         return out
 
     def _admit(self) -> None:
@@ -671,7 +724,7 @@ class ServeEngine:
             prompt = np.asarray(req.prompt, np.int32).reshape(-1)
             total = prompt.size + req.max_new_tokens
             total_slots = self.pool.pages_needed(total)
-            match = (self.pool.share_prefix(prompt)
+            match = (self.pool.share_prefix(prompt, rid=req.rid)
                      if self.cfg.prefix_cache else None)
             # fresh pages: everything past the FULL-page hits. A frontier
             # (partial-page) hit replaces one fresh prompt page but
@@ -687,6 +740,18 @@ class ServeEngine:
             self.queue.popleft()
             now = self._now()
             self._observe_wait(now - enq_ts)
+            if self.tracer is not None:
+                # the queue span closes the moment the request leaves the
+                # backlog — with prefill starting the same instant, queue +
+                # prefill tile admit->first-token exactly (the attribution
+                # sum-check's first half)
+                tr = self.tracer
+                tid, sid, par = tr.ids(req.rid, "queue")
+                tr.ledger.emit("span", trace_id=tid, span_id=sid,
+                               parent_id=par, name="queue", rid=req.rid,
+                               start=round(enq_ts, 6), end=round(now, 6),
+                               queue_depth=len(self.queue),
+                               tenant=req.tenant, **tr.attrs())
             self._prefill(i, req, prompt, fresh, enq_ts, now, match)
 
     def _prefill(self, slot_idx, req, prompt, fresh, enq_ts, start_ts,
@@ -743,7 +808,7 @@ class ServeEngine:
                      buf=np.zeros((p + req.max_new_tokens,), np.int32),
                      prompt_len=p, admit_ts=enq_ts, start_ts=start_ts,
                      position=p, generated=1, first_token_ts=now,
-                     cow_pending=cow)
+                     cow_pending=cow, win_start_ts=now)
         slot.buf[:p] = prompt
         slot.buf[p] = tok
         if (slot.generated >= req.max_new_tokens
@@ -751,6 +816,20 @@ class ServeEngine:
             slot.done = True
             slot.finish_ts = now
         self.slots[slot_idx] = slot
+        if self.tracer is not None:
+            # prefill span: queue-exit -> first token, carrying the knobs
+            # that explain a slow one (bucket padding, fresh vs shared
+            # pages, a pending CoW fork)
+            tr = self.tracer
+            tid, sid, par = tr.ids(req.rid, "prefill")
+            tr.ledger.emit("span", trace_id=tid, span_id=sid,
+                           parent_id=par, name="prefill", rid=req.rid,
+                           start=round(start_ts, 6), end=round(now, 6),
+                           bucket=bucket, prompt_len=p,
+                           pages_fresh=len(fresh),
+                           pages_shared=len(shared),
+                           shared_len=shared_len, cow=cow is not None,
+                           tenant=req.tenant, **tr.attrs())
 
     def _resolve_cow(self, active) -> None:
         """Fork every pending shared frontier page before this tick's
@@ -762,7 +841,8 @@ class ServeEngine:
             if s.cow_pending is None:
                 continue
             bt_slot, src, dst = s.cow_pending
-            self.pool.fork_page(src, dst)   # copies arenas, drops our src ref
+            # copies arenas, drops our src ref
+            self.pool.fork_page(src, dst, rid=s.req.rid)
             if self.draft_pool is not None:
                 src_a = jnp.asarray([src], jnp.int32)
                 dst_a = jnp.asarray([dst], jnp.int32)
@@ -811,6 +891,7 @@ class ServeEngine:
                     or tok == self.cfg.eos_id):
                 s.done = True
                 s.finish_ts = now
+            self._note_decode(s, now, tokens=1)
         self.ticks += 1
         self._occupancy_sum += len(active) / max(len(self.slots), 1)
 
@@ -844,20 +925,47 @@ class ServeEngine:
         emitted, emit_n = map(np.asarray, jax.device_get((emitted, emit_n)))
         now = self._now()
         for i, s in active:
+            took = 0
             for j in range(int(emit_n[i])):
                 tok = int(emitted[i, j])
                 s.buf[s.prompt_len + s.generated] = tok
                 s.generated += 1
                 s.position += 1
                 self.spec_emitted += 1
+                took += 1
                 if (s.generated >= s.req.max_new_tokens
                         or tok == self.cfg.eos_id):
                     s.done = True
                     s.finish_ts = now
                     break
             self.spec_slot_ticks += 1
+            self._note_decode(s, now, tokens=took, drafted=k)
         self.ticks += 1
         self._occupancy_sum += len(active) / max(len(self.slots), 1)
+
+    def _note_decode(self, s: _Slot, now: float, tokens: int,
+                     drafted: int = 0) -> None:
+        """Advance the slot's open decode window; close it into a span
+        every ``trace_window_ticks`` ticks and at finish. Consecutive
+        windows share their boundary timestamp, so a request's decode
+        spans tile first-token->finish with zero residue — the property
+        the attribution sum-check (tools/request_report.py) leans on."""
+        if self.tracer is None:
+            return
+        s.win_ticks += 1
+        s.win_tokens += tokens
+        s.win_drafted += drafted
+        if not s.done and s.win_ticks < max(self.cfg.trace_window_ticks, 1):
+            return
+        tr = self.tracer
+        tid, sid, par = tr.ids(s.req.rid, "decode")
+        tr.ledger.emit("span", trace_id=tid, span_id=sid, parent_id=par,
+                       name="decode", rid=s.req.rid,
+                       start=round(s.win_start_ts, 6), end=round(now, 6),
+                       ticks=s.win_ticks, tokens=s.win_tokens,
+                       spec_drafted=s.win_drafted, **tr.attrs())
+        s.win_start_ts = now
+        s.win_ticks = s.win_tokens = s.win_drafted = 0
 
     def _emit_kv_cache(self) -> None:
         if self.ledger is None:

@@ -134,6 +134,17 @@ EVENT_SCHEMA = {
     # cumulative fleet-wide breach count. Feeds the
     # tpu_dist_fleet_* Prometheus series through the metrics sink
     "fleet": ("hosts_live", "goodput_ratio", "slo_breaches"),
+    # one request-lifecycle span (obs.reqtrace): per-request distributed
+    # tracing. Ids are DERIVED, not generated — trace_id = H(ns|rid) is
+    # host-independent (cross-host traces stitch by equality alone),
+    # span_id/parent_id chain H(parent|name|n) under the per-(job_id,
+    # attempt) root. start/end are ENGINE-CLOCK seconds (comparable
+    # within one process only; emit's wall ``ts`` anchors cross-host
+    # placement). name is the lifecycle phase (request|queue|prefill|
+    # decode|shed|readmit|prefix_hit|cow_fork); job_id/attempt/host/
+    # tenant/reason/bucket/tokens ride as extras
+    "span": ("trace_id", "span_id", "parent_id", "name", "rid",
+             "start", "end"),
     # resolved step plan (tpu_dist.plan): which tuned/loaded plan drove
     # this run's step compilation — source names the file|'auto', plan_hash
     # the content address (plan.ir.plan_hash), knobs the non-default knob

@@ -376,6 +376,12 @@ def metrics_ledger_sink(reg: MetricsRegistry):
                                 "submissions rejected by admission control")
     serve_toks = reg.counter("tpu_dist_serve_tokens_total",
                              "tokens generated by the serving engine")
+    # per-request tracing (obs.reqtrace): the root 'request' span carries
+    # the measured TTFT, so the histogram is fed by the span stream — the
+    # scrape-side face of the request observatory
+    req_ttft = reg.histogram("tpu_dist_request_ttft_seconds",
+                             "per-request time-to-first-token seconds, "
+                             "from root request spans")
     # elastic capacity (parallel.consensus / supervisor `scale` events):
     # the live mesh size and the degraded flag, so a dashboard shows a
     # shrink/re-expansion cycle without parsing ledgers
@@ -408,7 +414,7 @@ def metrics_ledger_sink(reg: MetricsRegistry):
     for m in (steps, items, mfu, loss, stalls, stall_idle, skew_spread,
               straggler, epoch_g, eval_loss, hbm, decode_toks, step_hist,
               goodput_ratio, serve_queue, serve_active, kv_free, serve_reqs,
-              serve_rejects, serve_toks, mesh_procs, degraded_g,
+              serve_rejects, serve_toks, req_ttft, mesh_procs, degraded_g,
               fleet_ratio, fleet_hosts, fleet_breaches):
         m.labels()
 
@@ -488,6 +494,12 @@ def metrics_ledger_sink(reg: MetricsRegistry):
             serve_reqs.inc()
             if rec.get("tokens"):
                 serve_toks.inc(rec["tokens"])
+        elif ev == "span":
+            # only the root span carries a request-level TTFT; child spans
+            # (queue/prefill/decode windows) are trace detail, not samples
+            if (rec.get("name") == "request"
+                    and rec.get("ttft_s") is not None):
+                req_ttft.observe(rec["ttft_s"])
         elif ev == "kv_cache":
             if rec.get("pages_free") is not None:
                 kv_free.set(rec["pages_free"])

@@ -196,6 +196,30 @@ class FleetLedger:
                 "ttft_s": {"p50": _pctl(ttfts, 50), "p99": _pctl(ttfts, 99)}}
         return out
 
+    def traces(self) -> Dict[str, dict]:
+        """Cross-host request traces, stitched by trace_id equality alone
+        (obs.reqtrace derives host-independent ids, so hosts that never
+        exchanged a byte mint the same id for the same rid) — the request
+        analog of the goodput stitch. Returns trace_id -> a summary row:
+        which hosts touched the request, span/shed/readmit counts, and
+        whether ANY host completed it (a root ``request`` span exists).
+        The heavy per-trace machinery (waterfalls, attribution, exemplars)
+        lives in tools/request_report.py over :meth:`merged`."""
+        from tpu_dist.obs import reqtrace
+
+        out = {}
+        for tid, tr in sorted(reqtrace.traces(self.merged()).items()):
+            names = [s.get("name") for s in tr["spans"]]
+            out[tid] = {
+                "rid": tr["rid"],
+                "hosts": tr["hosts"],
+                "spans": len(tr["spans"]),
+                "sheds": sum(1 for n in names if n == "shed"),
+                "readmits": sum(1 for n in names if n == "readmit"),
+                "completed": bool(tr["roots"]),
+            }
+        return out
+
     def serving_totals(self) -> dict:
         completed = rejected = 0
         for recs in self.hosts.values():
@@ -233,5 +257,6 @@ class FleetLedger:
             "elasticity": self.elasticity(),
             "per_tenant": self.per_tenant(),
             "serving": self.serving_totals(),
+            "traces": self.traces(),
             "hosts_live": self.hosts_live_timeline(),
         }

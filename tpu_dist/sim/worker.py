@@ -150,6 +150,7 @@ def main(argv=None) -> int:
     from tpu_dist.engine.serve import DecodeRequest, ServeConfig, ServeEngine
     from tpu_dist.models.transformer import tiny_lm
     from tpu_dist.obs import RunObs
+    from tpu_dist.obs.reqtrace import RequestTracer
     from tpu_dist.parallel.supervisor import PREEMPT_SNAPSHOT_RC
 
     model_kw = {"vocab_size": 64, "num_layers": 1, "d_model": 32,
@@ -174,8 +175,15 @@ def main(argv=None) -> int:
     params = lm.init({"params": jax.random.PRNGKey(sc.seed)},
                      jnp.zeros((1, model_kw["max_len"]), jnp.int32),
                      train=False)["params"]
+    # trace context (obs.reqtrace): the namespace is the SCENARIO name,
+    # not this host's job_id — every host derives the same trace_id for
+    # the same rid, so a request shed here and re-admitted elsewhere
+    # stitches into one trace (sim.fleet.FleetLedger.traces)
+    tracer = RequestTracer(obs.ledger, job_id=cfg.job_id,
+                           attempt=obs.attempt, host=args.host,
+                           trace_ns=sc.name)
     eng = ServeEngine(lm, params, ServeConfig(**serve_kw),
-                      ledger=obs.ledger)
+                      ledger=obs.ledger, tracer=tracer)
     arrival_rng = np.random.default_rng(sc.seed * 7919 + args.host)
 
     def _prompt(a):
@@ -220,6 +228,20 @@ def main(argv=None) -> int:
             t0 = time.perf_counter()
             while i < len(arrivals) and arrivals[i].tick <= tick:
                 a = arrivals[i]
+                if start_tick > 0 and a.tick < start_tick:
+                    # this rid was scheduled before the resume point and
+                    # never completed — a prior attempt shed it, and this
+                    # attempt is the re-admission. The zero-duration
+                    # readmit span binds the two attempts' spans into one
+                    # trace (same derived trace_id)
+                    t_now = time.monotonic()
+                    tid, sid, par = tracer.ids(a.rid, "readmit")
+                    obs.ledger.emit(
+                        "span", trace_id=tid, span_id=sid, parent_id=par,
+                        name="readmit", rid=a.rid,
+                        start=round(t_now, 6), end=round(t_now, 6),
+                        from_tick=a.tick, at_tick=tick, tenant=a.tenant,
+                        **tracer.attrs())
                 eng.submit(DecodeRequest(a.rid, _prompt(a), a.out_len,
                                          tenant=a.tenant))
                 i += 1
