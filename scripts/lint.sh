@@ -216,6 +216,51 @@ assert all(b["exemplars"] for b in summary["slo_exemplars"]), \
 print("reqtrace gate: OK (no jax, deterministic)")
 EOF
 
+# Program-audit gate (round 18): proglint over every plan in the tuner's
+# canned-CI candidate space (scripts/tune_ci.json names the device kind).
+# Unlike the gates above this one NEEDS jax — it traces real programs —
+# so it is guarded on availability instead of blocking the import: a
+# bare login host still runs every other gate. Abstract tracing only
+# (eval_shape-class work, CPU, nothing executes); run TWICE because the
+# canonical report is a CI artifact and artifact diffing needs it
+# byte-deterministic. Publishes proglint.json + proglint.sarif next to
+# distlint.sarif.
+if python -c "import jax" >/dev/null 2>&1; then
+python - <<'EOF'
+import json
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from tpu_dist._compat import set_cpu_device_count
+
+set_cpu_device_count(8)
+from tpu_dist.analysis.proglint import Finding, audit_tune_space, to_sarif
+
+with open("scripts/tune_ci.json") as f:
+    json.load(f)   # the canned space must exist and parse
+
+r1 = audit_tune_space()
+r2 = audit_tune_space()
+text = json.dumps(r1, indent=1, sort_keys=True) + "\n"
+assert text == json.dumps(r2, indent=1, sort_keys=True) + "\n", \
+    "proglint report is not byte-deterministic"
+assert r1["unwaivered"] == 0, \
+    "unwaivered program-audit findings:\n" + "\n".join(
+        Finding(**d).render() for d in r1["findings"] if not d["waived"])
+with open("proglint.json", "w") as f:
+    f.write(text)
+with open("proglint.sarif", "w") as f:
+    json.dump(to_sarif([Finding(**d) for d in r1["findings"]]), f,
+              indent=2, sort_keys=True)
+    f.write("\n")
+print(f"proglint gate: OK ({r1['plans']} plan(s) -> {r1['programs']} "
+      f"program(s), {r1['unwaivered']} unwaivered, deterministic)")
+EOF
+else
+    echo "proglint gate: SKIPPED (no jax on this host; program tracing needs it)"
+fi
+
 # Advisory tier-1 budget creep warning (never fails the gate): conftest
 # writes each full-suite run's wall time + top-20 durations to
 # /tmp/tier1_durations.json (TPU_DIST_TIER1_DURATIONS overrides); the

@@ -1,0 +1,17 @@
+"""DL003 positive fixture (serving-era spellings): mesh.shape[...]
+subscripts and axis_size() with axis names the mesh never declared."""
+
+import jax
+
+
+def bad_pool_sizing(mesh, cfg):
+    # 'modle' typo in the paged-pool sizing path: KeyError only when the
+    # serve tick first sizes the axis on hardware
+    tp = mesh.shape["modle"]
+    return cfg.pages_total // tp
+
+
+def bad_draft_span(x):
+    # the spec-decode draft fan-out sized off a typo'd axis
+    n = jax.lax.axis_size("dataa")
+    return x * n

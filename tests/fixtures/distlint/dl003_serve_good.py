@@ -1,0 +1,23 @@
+"""DL003 negative fixture (serving-era spellings): declared axes and
+dynamic keys in mesh.shape[...] / axis_size() call sites."""
+
+import jax
+import numpy as np
+
+
+def good_pool_sizing(mesh, cfg, axis):
+    tp = mesh.shape["model"]                 # declared axis
+    dyn = mesh.shape[axis]                   # dynamic key: not checked
+    return cfg.pages_total // (tp * dyn)
+
+
+def good_draft_span(x, axis_name):
+    n = jax.lax.axis_size("data")            # declared axis
+    m = jax.lax.axis_size(axis_name)         # dynamic: not checked
+    return x * n * m
+
+
+def int_shape_subscripts(batch):
+    # array .shape subscripts are ints — never axis names, never flagged
+    rows = batch.shape[0]
+    return np.zeros((rows, batch.shape[1]))

@@ -42,3 +42,10 @@ def emit_plan_badly(ledger):
     # round 15: the step-plan events (tpu_dist.plan) are schema-checked
     ledger.emit("plan", source="plans.json")     # missing plan_hash/knobs
     ledger.emit("tune", device_kind="v5e")       # missing candidates/best
+
+
+def emit_audit_badly(ledger, meta):
+    # round 18: the program-audit event (analysis.proglint via
+    # plan.compile) is schema-checked like the rest
+    ledger.emit("audit", program="train_step")   # missing mode + findings
+    ledger.emit("audit", **meta)                 # required fields in a splat

@@ -77,3 +77,11 @@ def emit_plan_well(ledger):
     ledger.emit("tune", device_kind="cpu", candidates=72,
                 best_hash="c456df519e8b", best_step_s=0.0021,
                 measured=True, peaks_nominal=False)
+
+
+def emit_audit_well(ledger):
+    # round 18: the program-audit event (analysis.proglint findings,
+    # emitted by plan.compile's audit pass) — findings is the UNWAIVERED
+    # count; the waived count and per-finding detail ride as extras
+    ledger.emit("audit", program="train_step", mode="record", findings=0,
+                waived=1, detail=[{"check": "PL003", "waived": True}])

@@ -1,0 +1,48 @@
+"""DL201 positive fixture: cond/switch branches whose collective
+sequences diverge — the statically-provable MPI-matching deadlock."""
+
+import jax
+from functools import partial
+
+
+def asymmetric_order(pred, x):
+    # both branches issue the SAME collectives but in OPPOSITE order: a
+    # process taking the other arm pairs its psum with the peer's pmax
+    def hot(v):
+        v = jax.lax.psum(v, "data")
+        return jax.lax.pmax(v, "data")
+
+    def cold(v):
+        v = jax.lax.pmax(v, "data")
+        return jax.lax.psum(v, "data")
+
+    return jax.lax.cond(pred, hot, cold, x)
+
+
+def one_armed_collective(pred, x):
+    # lambda branches: the true arm reduces, the false arm doesn't — the
+    # excluded processes never enter the psum and the pod hangs
+    return jax.lax.cond(pred,
+                        lambda v: jax.lax.psum(v, "data"),
+                        lambda v: v * 2.0, x)
+
+
+def _gather_path(v):
+    return jax.lax.all_gather(v, "model")
+
+
+def _reduce_path(v):
+    return jax.lax.psum(v, "model")
+
+
+def divergent_switch(idx, x):
+    # switch over helper refs resolved through the call graph: three
+    # branches, three different collective sequences
+    return jax.lax.switch(idx, [_gather_path, _reduce_path,
+                                lambda v: v], x)
+
+
+def partial_head(pred, x, scale):
+    # partial() heads resolve to their wrapped callable
+    return jax.lax.cond(pred, partial(_reduce_path),
+                        lambda v: v + scale, x)

@@ -1,0 +1,38 @@
+"""DL201 negative fixture: branch collective sequences that match (or
+contain no collectives at all) — the safe cond/switch shapes."""
+
+import jax
+
+
+def identical_sequences(pred, x):
+    # both arms issue psum("data") then pmax("data"): any process pairing
+    # is consistent regardless of the branch taken
+    def hot(v):
+        v = jax.lax.psum(v * 2.0, "data")
+        return jax.lax.pmax(v, "data")
+
+    def cold(v):
+        v = jax.lax.psum(v * 0.5, "data")
+        return jax.lax.pmax(v, "data")
+
+    return jax.lax.cond(pred, hot, cold, x)
+
+
+def no_collectives(pred, x):
+    # pure element-wise branches: nothing to mismatch (the pp.py microbatch
+    # gating shape — collectives stay OUTSIDE the cond)
+    y = jax.lax.cond(pred, lambda v: v * 2.0, lambda v: v + 1.0, x)
+    return jax.lax.psum(y, "data")
+
+
+def padded_branch(pred, x):
+    # the sanctioned fix for a one-armed reduce: the other arm issues the
+    # SAME collective on a zero operand
+    return jax.lax.cond(pred,
+                        lambda v: jax.lax.psum(v, "data"),
+                        lambda v: v + jax.lax.psum(v * 0.0, "data"), x)
+
+
+def dynamic_branches(pred, fns, x):
+    # branch list built at runtime: not statically resolvable, stays silent
+    return jax.lax.switch(pred, fns, x)

@@ -42,9 +42,10 @@ def _full_lint():
 # an extra finding is a false positive creeping into the rule, a missing
 # one is a detection regression; both should fail loudly here
 EXPECTED_BAD_COUNTS = {"DL001": 2, "DL002": 3, "DL003": 3,
-                       "DL004": 4, "DL005": 3, "DL006": 14, "DL007": 2,
+                       "DL004": 4, "DL005": 3, "DL006": 16, "DL007": 2,
                        "DL008": 2,
-                       "DL101": 1, "DL102": 2, "DL103": 2, "DL104": 3}
+                       "DL101": 1, "DL102": 2, "DL103": 2, "DL104": 3,
+                       "DL201": 4}
 
 
 def lint_fixture(name: str, rule_id: str):
@@ -70,7 +71,7 @@ def test_rule_silent_on_good_fixture(rule_id):
 
 
 def test_rules_have_distinct_ids_and_docs():
-    assert len(RULE_IDS) == len(set(RULE_IDS)) >= 11
+    assert len(RULE_IDS) == len(set(RULE_IDS)) >= 13
     for r in RULES:
         assert r.title and r.rationale
         assert getattr(r, "severity", None) in ("error", "warn")
@@ -293,6 +294,49 @@ def test_dl002_closure_seam_pair():
     assert "reachable" in bad.findings[0].message
     good = lint_files([os.path.join(FIXTURES, "dl002_closure_good.py")],
                       select=["DL002"])
+    assert good.findings == [], [f.render() for f in good.findings]
+
+
+def test_dl201_branch_order_pair():
+    """PR 18's source-level MPI-matching prover: cond/switch branches
+    whose ordered collective sequences diverge are flagged (helper refs
+    resolve through the call graph, lambdas and partial() heads inline),
+    while identical sequences, collective-free branches (the pp.py
+    gating shape), the padded-zero-operand fix, and dynamically built
+    branch lists all stay silent."""
+    bad = lint_files([os.path.join(FIXTURES, "dl201_bad.py")],
+                     select=["DL201"])
+    assert len(bad.findings) == 4, [f.render() for f in bad.findings]
+    msgs = [f.message for f in bad.findings]
+    # the asymmetric-order shape renders BOTH sequences, in order
+    assert any("psum(data) -> pmax(data)" in m
+               and "pmax(data) -> psum(data)" in m for m in msgs), msgs
+    # the one-armed shape names the silent arm explicitly
+    assert any("[no collectives]" in m for m in msgs)
+    good = lint_files([os.path.join(FIXTURES, "dl201_good.py")],
+                      select=["DL201"])
+    assert good.findings == [], [f.render() for f in good.findings]
+    # the real pipeline engine leans on per-device lax.cond gating with
+    # collectives hoisted OUTSIDE the cond — it must stay clean
+    shipped = lint_files([os.path.join("tpu_dist", "parallel", "pp.py")],
+                         select=["DL201"])
+    assert shipped.findings == [], [f.render() for f in shipped.findings]
+
+
+def test_dl003_serve_era_spellings_pair():
+    """Satellite of PR 18: the axis authority extends to the serving /
+    spec-decode spellings added since PR 8 — mesh.shape["axis"] string
+    subscripts and axis_size() first-positional axis names — while int
+    array-.shape subscripts and dynamic keys stay silent."""
+    bad = lint_files([os.path.join(FIXTURES, "dl003_serve_bad.py")],
+                     select=["DL003"])
+    assert len(bad.findings) == 2, [f.render() for f in bad.findings]
+    assert any("mesh.shape[...]" in f.message and "modle" in f.message
+               for f in bad.findings)
+    assert any("axis_size()" in f.message and "dataa" in f.message
+               for f in bad.findings)
+    good = lint_files([os.path.join(FIXTURES, "dl003_serve_good.py")],
+                      select=["DL003"])
     assert good.findings == [], [f.render() for f in good.findings]
 
 
@@ -558,6 +602,26 @@ def test_sarif_minimal_schema_shape():
         assert region["startLine"] >= 1 and region["startColumn"] >= 1
         uri = r["locations"][0]["physicalLocation"]["artifactLocation"]
         assert uri["uri"].endswith("dl003_bad.py")
+
+
+def test_sarif_golden_snapshot():
+    """Byte-level SARIF pin (satellite of PR 18): the structural checks
+    above can't catch a field rename or an ordering regression that
+    still satisfies the schema — CI dashboards parse these artifacts, so
+    the exact serialization is contract. Regenerate deliberately with:
+    python -c "import json,os; from tools.distlint import lint_files; \\
+    from tools.distlint.report import to_sarif; print(json.dumps(
+    to_sarif(lint_files([os.path.join('tests','fixtures','distlint',
+    'dl003_bad.py')], select=['DL003'])), indent=2, sort_keys=True))"
+    """
+    res = lint_files([os.path.join(FIXTURES, "dl003_bad.py")],
+                     select=["DL003"])
+    got = json.dumps(to_sarif(res), indent=2, sort_keys=True) + "\n"
+    with open(os.path.join(FIXTURES, "golden_dl003.sarif.json")) as f:
+        want = f.read()
+    assert got == want, ("SARIF serialization drifted from the golden "
+                         "snapshot — if intentional, regenerate "
+                         "tests/fixtures/distlint/golden_dl003.sarif.json")
 
 
 def test_sarif_cli_and_artifact(tmp_path, capsys):

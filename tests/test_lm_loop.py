@@ -204,6 +204,11 @@ def test_lm_resume_geometry_mismatch_fails_before_load(tmp_path):
         LMTrainer(LMConfig(resume=ck, **bad))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): ~6s composite whose pieces stay
+# covered in-budget — metric-sum exactness by
+# test_lm.py::test_lm_eval_step_exact_metrics, wrap-padding mask math by
+# test_engine.py::test_eval_step_counts_mask_padding and
+# test_sampler.py's validity masks
 def test_lm_eval_exact_under_padding():
     """Held-out ppl masks sampler wrap-padding: indexed one-dispatch eval ==
     a hand-rolled forward over exactly the real val rows."""

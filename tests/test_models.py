@@ -159,11 +159,14 @@ def test_inception_v3_forward_96px():
 
 
 # tier-1 budget (PR 10): the two bottleneck variants are ~9s compiles each
-# and near-duplicates of one another; the widened plan stays live, the
-# grouped one keeps its exact param-count pin
+# and near-duplicates of one another; the grouped one keeps its exact
+# param-count pin. PR 18 moves the widened one out of budget too: the
+# standard-width Bottleneck forward stays live via resnet50 above, and the
+# widened geometry keeps its exact pin in
+# test_mobile_class_param_count_matches_torchvision[wide_resnet50_2]
 @pytest.mark.parametrize("arch", [
     pytest.param("resnext50_32x4d", marks=pytest.mark.slow),
-    "wide_resnet50_2"])
+    pytest.param("wide_resnet50_2", marks=pytest.mark.slow)])
 def test_resnet_variant_forward_shape(arch):
     """Grouped (ResNeXt) and widened (WideResNet) bottleneck plans."""
     m = create_model(arch, num_classes=10)

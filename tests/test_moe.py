@@ -121,6 +121,10 @@ def test_top2_routing_dispatches_two_experts():
     assert float(jnp.max(jnp.abs(out - out1))) > 1e-6
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): ~6s near-duplicate — the train
+# loop stays covered in-budget by test_moe_lm_trains (top-1, same step
+# builder) and top-2 routing semantics by the combine-mass unit +
+# test_top2_capacity_overflow_drops_second_choice
 def test_top2_moe_lm_trains(moe_setup):
     _, _, tx, inputs, targets = (*moe_setup,)
     model = MoETransformerLM(vocab_size=V, max_len=L, num_experts=E,

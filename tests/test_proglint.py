@@ -1,0 +1,373 @@
+"""proglint: the program-level auditor (PR 18 tentpole).
+
+Injected-hazard coverage: every check trips on a program built to carry
+its hazard and stays silent on the clean control — plus the audit-pass
+modes (none/record/halt), the reason-required waiver grammar, the
+ledger/metrics/report integration, and THE tier-1 pin: the tuner's whole
+candidate space traces clean (0 unwaivered findings) byte-deterministically.
+"""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_dist._compat import shard_map
+from tpu_dist.analysis.proglint import (AuditError, Finding,
+                                        RecompileSentry, apply_waivers,
+                                        audit_jaxpr, audit_tune_space,
+                                        collective_signature,
+                                        donation_aliased,
+                                        mesh_axis_authority, parse_waivers,
+                                        to_sarif, unwaivered)
+from tpu_dist.plan import compile as plan_compile
+
+
+@pytest.fixture(autouse=True)
+def _audit_off():
+    """Every test leaves the process-global audit switch disarmed."""
+    yield
+    plan_compile.set_audit("none")
+
+
+def _mesh(axis: str, n: int = 8) -> Mesh:
+    return Mesh(np.array(jax.devices()[:n]), (axis,))
+
+
+def _psum_program(axis: str):
+    """A shard_map'd all-reduce over ``axis`` — the mesh may well declare
+    the axis (shard_map requires it); the AUTHORITY may not (PL001)."""
+    def step(x):
+        return jax.lax.psum(x, axis)
+    return shard_map(step, mesh=_mesh(axis), in_specs=P(axis),
+                     out_specs=P())
+
+
+class _Led:
+    """Ledger stub: records emits, keeps the test free of file I/O."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **fields):
+        self.events.append({"event": event, **fields})
+
+    def audits(self):
+        return [e for e in self.events if e["event"] == "audit"]
+
+
+# ------------------------------------------------------- waiver grammar
+def test_waiver_grammar_parses_reasons_and_flags_debt():
+    waivers, meta = parse_waivers(
+        "# comment line\n"
+        "\n"
+        "PL003 serve_* -- bucket shardings differ by design\n"
+        "PL001 train_step   # no reason at all\n"
+        "PL004 -- reason but no program glob\n"
+        "DL003 x -- wrong namespace\n", origin="w.txt")
+    assert len(waivers) == 1
+    w = waivers[0]
+    assert (w.check, w.pattern) == ("PL003", "serve_*")
+    assert w.reason == "bucket shardings differ by design"
+    # every malformed line is a PL000 finding, never silently honored
+    assert [m.check for m in meta] == ["PL000"] * 3
+    assert any("no reason" in m.message for m in meta)
+    assert any("unparseable" in m.message for m in meta)
+
+
+def test_apply_waivers_glob_match_and_unwaivered_filter():
+    waivers, _ = parse_waivers("PL001 serve_* -- draft axis is synthetic\n")
+    fs = [Finding("PL001", "serve_tick", "x"),
+          Finding("PL001", "train_step", "x"),
+          Finding("PL002", "serve_tick", "x")]   # other check: no match
+    out = apply_waivers(fs, waivers)
+    assert [f.waived for f in out] == [True, False, False]
+    assert out[0].reason == "draft axis is synthetic"
+    assert [f.program for f in unwaivered(out)] == ["train_step",
+                                                    "serve_tick"]
+    assert "[waived:" in out[0].render()
+
+
+# ------------------------------------------- the jaxpr/HLO checks trip
+def test_pl001_unknown_collective_axis_trips_and_control_is_clean():
+    x = jnp.arange(8.0)
+    bad = jax.make_jaxpr(_psum_program("batch"))(x)   # torch habit axis
+    fs = audit_jaxpr("p", bad)
+    assert [f.check for f in fs] == ["PL001"]
+    assert "'batch'" in fs[0].message
+    assert "batch" not in mesh_axis_authority()
+    good = jax.make_jaxpr(_psum_program("data"))(x)
+    assert audit_jaxpr("p", good) == []
+
+
+def test_pl002_asymmetric_cond_psum_order_trips_proglint_and_dl201(
+        tmp_path):
+    """THE acceptance hazard: a cond whose arms issue psum/pmax in
+    opposite order is flagged by BOTH halves — PL002 on the traced jaxpr
+    and DL201 on the equivalent source."""
+    def step(x):
+        def hot(v):
+            return jax.lax.pmax(jax.lax.psum(v, "data"), "data")
+
+        def cold(v):
+            return jax.lax.psum(jax.lax.pmax(v, "data"), "data")
+        return jax.lax.cond(  # distlint: disable=DL201 -- test: the injected hazard under test
+            x[0] > 0, hot, cold, x)
+
+    f = shard_map(step, mesh=_mesh("data"), in_specs=P("data"),
+                  out_specs=P(), check_vma=False)
+    closed = jax.make_jaxpr(f)(jnp.arange(8.0))
+    fs = audit_jaxpr("p", closed)
+    assert [x.check for x in fs] == ["PL002"]
+    assert "divergent collective sequences" in fs[0].message
+    # the source twin through distlint's DL201 prover
+    from tools.distlint import lint_files
+    p = tmp_path / "twin.py"
+    p.write_text(
+        "import jax\n"
+        "def step(pred, x):\n"
+        "    def hot(v):\n"
+        "        v = jax.lax.psum(v, 'data')\n"
+        "        return jax.lax.pmax(v, 'data')\n"
+        "    def cold(v):\n"
+        "        v = jax.lax.pmax(v, 'data')\n"
+        "        return jax.lax.psum(v, 'data')\n"
+        "    return jax.lax.cond(pred, hot, cold, x)\n")
+    res = lint_files([str(p)], select=["DL201"])
+    assert len(res.findings) == 1, [x.render() for x in res.findings]
+    assert res.findings[0].rule == "DL201"
+
+
+def test_pl002_symmetric_cond_and_while_are_exempt():
+    def step(x):
+        body = lambda v: jax.lax.psum(v, "data")          # noqa: E731
+        y = jax.lax.cond(x[0] > 0, body, body, x)
+        # while: ONE body, same trip count on every device — exempt
+        return jax.lax.while_loop(lambda c: c[1] < 3,
+                                  lambda c: (jax.lax.psum(c[0], "data"),
+                                             c[1] + 1), (y, 0))[0]
+
+    f = shard_map(step, mesh=_mesh("data"), in_specs=P("data"),
+                  out_specs=P(), check_vma=False)
+    assert audit_jaxpr("p", jax.make_jaxpr(f)(jnp.arange(8.0))) == []
+
+
+def test_pl003_sharding_mismatch_drops_donation_and_is_flagged():
+    """The silent HBM doubler: XLA drops donate_argnums on a sharding
+    mismatch with only a warning; the compiled module's header is the
+    proof (input_output_alias present iff honored)."""
+    mesh = _mesh("data")
+    sh = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+    x = jnp.arange(8.0)
+
+    def f(v):
+        return v * 2.0
+
+    honored = jax.jit(f, donate_argnums=(0,), in_shardings=sh,
+                      out_shardings=sh)
+    dropped = jax.jit(f, donate_argnums=(0,), in_shardings=sh,
+                      out_shardings=rep)   # replicated out: cannot alias
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")    # "donated buffers not usable"
+        hlo_ok = honored.lower(x).compile().as_text()
+        hlo_bad = dropped.lower(x).compile().as_text()
+    assert donation_aliased(hlo_ok) and not donation_aliased(hlo_bad)
+    assert audit_jaxpr("p", jax.make_jaxpr(honored)(x), hlo=hlo_ok) == []
+    fs = audit_jaxpr("p", jax.make_jaxpr(dropped)(x), hlo=hlo_bad)
+    assert [f_.check for f_ in fs] == ["PL003"]
+    assert "double-buffered" in fs[0].message
+    # no donation declared: silence regardless of the header
+    plain = jax.jit(f, in_shardings=sh, out_shardings=rep)
+    assert audit_jaxpr("p", jax.make_jaxpr(plain)(x), hlo=hlo_bad) == []
+
+
+def test_pl004_f32_leak_in_bf16_program_and_exemptions():
+    a32 = jnp.ones((4, 4), jnp.float32)
+    a16 = jnp.ones((4, 4), jnp.bfloat16)
+
+    def mm(a, b):
+        return a @ b
+
+    leak = jax.make_jaxpr(mm)(a32, a32)
+    fs = audit_jaxpr("p", leak, precision="bf16")
+    assert [f.check for f in fs] == ["PL004"]
+    assert "dot_general" in fs[0].message and "f32" in fs[0].message
+    # fp32 program: f32 compute is the declared contract
+    assert audit_jaxpr("p", leak, precision="fp32") == []
+    # bf16_params (master-weights style) KEEPS f32 compute on purpose
+    assert audit_jaxpr("p", leak, precision="bf16_params") == []
+    # actual bf16 compute in a bf16 program: clean
+    assert audit_jaxpr("p", jax.make_jaxpr(mm)(a16, a16),
+                       precision="bf16") == []
+
+
+def test_pl005_sentry_latches_one_finding_per_program():
+    sentry = RecompileSentry()
+    f = jax.jit(lambda x: x * 2.0)
+    sentry.register("vary", f, allowed=1)
+    f(jnp.ones(2))
+    assert sentry.check() == []            # one shape: within budget
+    f(jnp.ones(3))
+    f(jnp.ones(4))
+    fs = sentry.check()
+    assert [x.check for x in fs] == ["PL005"]
+    assert "3 entries" in fs[0].message
+    assert sentry.check() == []            # latched: exactly one finding
+    # allowed>1 (serve prefill's bucket specialization) tolerates buckets
+    sentry2 = RecompileSentry()
+    g = jax.jit(lambda x: x + 1.0)
+    sentry2.register("prefill", g, allowed=3)
+    for n in (2, 3, 4):
+        g(jnp.ones(n))
+    assert sentry2.check() == []
+
+
+# ------------------------------------------------ the audit pass (knob)
+def test_set_audit_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        plan_compile.set_audit("loud")
+
+
+def test_audit_program_record_emits_one_event_halt_raises():
+    led = _Led()
+    plan_compile.set_audit("record", led)
+    x = jnp.arange(8.0)
+    fs = plan_compile.audit_program("bad_step", _psum_program("batch"), x)
+    assert [f.check for f in fs] == ["PL001"]
+    (ev,) = led.audits()                   # exactly one event per program
+    assert ev["program"] == "bad_step" and ev["mode"] == "record"
+    assert ev["findings"] == 1 and ev["waived"] == 0
+    assert ev["detail"][0]["check"] == "PL001"
+    # clean program: still exactly one event, zero findings
+    plan_compile.audit_program("good_step", _psum_program("data"), x)
+    assert [e["findings"] for e in led.audits()] == [1, 0]
+    # halt: same checks, but unwaivered findings are fatal
+    plan_compile.set_audit("halt", led)
+    with pytest.raises(AuditError, match="PL001"):
+        plan_compile.audit_program("bad_step", _psum_program("batch"), x)
+    # none: the pass is a no-op and emits nothing
+    plan_compile.set_audit("none", led)
+    n = len(led.events)
+    assert plan_compile.audit_program("bad_step",
+                                      _psum_program("batch"), x) == []
+    assert len(led.events) == n
+
+
+def test_check_audit_sentry_record_once_then_halt_raises():
+    led = _Led()
+    plan_compile.set_audit("record", led)
+    f = jax.jit(lambda x: x + 1.0)
+    plan_compile.register_audit_program("vary", f)
+    f(jnp.ones(2))
+    f(jnp.ones(3))
+    plan_compile.check_audit_sentry()
+    plan_compile.check_audit_sentry()      # latched: no second event
+    (ev,) = led.audits()
+    assert ev["program"] == "vary" and ev["findings"] == 1
+    assert ev["detail"][0]["check"] == "PL005"
+    # halt arms a FRESH sentry; the same shape-varying dispatch is fatal
+    plan_compile.set_audit("halt", led)
+    g = jax.jit(lambda x: x * 3.0)
+    plan_compile.register_audit_program("vary2", g)
+    g(jnp.ones(2))
+    g(jnp.ones(3))
+    with pytest.raises(AuditError, match="PL005"):
+        plan_compile.check_audit_sentry()
+
+
+# ------------------------------------- ledger / metrics / report wiring
+def test_audit_events_feed_metrics_and_report_sections():
+    from tpu_dist.obs.metrics import MetricsRegistry, metrics_ledger_sink
+    reg = MetricsRegistry()
+    sink = metrics_ledger_sink(reg)
+    # pre-registered: a clean run still scrapes zeros for every check
+    assert 'tpu_dist_audit_findings_total{check="PL003"} 0' in reg.render()
+    records = [
+        {"event": "audit", "program": "train_step", "mode": "record",
+         "findings": 1, "waived": 1, "detail": [
+             {"check": "PL003", "program": "train_step", "message": "m",
+              "waived": False, "reason": ""},
+             {"check": "PL001", "program": "train_step", "message": "m",
+              "waived": True, "reason": "r"}]},
+        {"event": "audit", "program": "serve_tick", "mode": "record",
+         "findings": 0, "waived": 0, "detail": None},
+    ]
+    for r in records:
+        sink(r)
+    text = reg.render()
+    assert 'tpu_dist_audit_findings_total{check="PL003"} 1' in text
+    # waived detail does NOT count
+    assert 'tpu_dist_audit_findings_total{check="PL001"} 0' in text
+    from tools.ledger_report import audit_section
+    lines = []
+    sec = audit_section(records, out=lines.append)
+    assert sec["mode"] == "record" and len(sec["programs"]) == 2
+    assert sec["findings"] == 1 and sec["waived"] == 1
+    assert sec["programs"]["train_step"]["checks"] == ["PL001", "PL003"]
+    assert any("train_step" in ln and "PL003" in ln for ln in lines)
+    # no audit events: the section stays out of the summary entirely
+    assert audit_section([{"event": "step"}], out=lines.append) is None
+
+
+def test_proglint_sarif_document_shape():
+    fs = [Finding("PL003", "train_step", "dropped"),
+          Finding("PL001", "serve_tick", "bad axis", waived=True,
+                  reason="synthetic axis")]
+    doc = to_sarif(fs)
+    assert doc["version"] == "2.1.0"
+    (run,) = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "proglint"
+    ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert ids == sorted(ids) and "PL000" in ids and "PL005" in ids
+    r_bad, r_waived = run["results"]
+    assert r_bad["level"] == "error"
+    assert r_waived["level"] == "note"
+    assert "[waived: synthetic axis]" in r_waived["message"]["text"]
+    uri = r_bad["locations"][0]["physicalLocation"]["artifactLocation"]
+    assert uri["uri"] == "programs/train_step"
+
+
+# --------------------------------------------- THE tier-1 pins (accept)
+def test_tune_space_audits_clean_and_byte_deterministic():
+    """Satellite 1's pin, the proglint twin of test_tree_is_clean: every
+    structurally-distinct program in the tuner's full candidate space
+    traces clean — 0 unwaivered findings — and the canonical report is
+    byte-identical across runs (CI artifact diffing depends on it)."""
+    r1 = audit_tune_space()
+    assert r1["unwaivered"] == 0, r1["findings"]
+    assert r1["plans"] == 72 and r1["programs"] == 8
+    assert len(r1["program_names"]) == r1["programs"]
+    r2 = audit_tune_space()
+    assert (json.dumps(r1, sort_keys=True)
+            == json.dumps(r2, sort_keys=True))
+
+
+def test_lm_smoke_audit_record_exactly_one_event_per_program(tmp_path):
+    """Acceptance: audit=record on the CPU LM smoke emits exactly one
+    clean audit event per program (compile-time pass + drain-boundary
+    counter read — the hot path never sees the auditor)."""
+    from tpu_dist.configs import LMConfig
+    from tpu_dist.engine.lm_loop import LMTrainer
+
+    path = str(tmp_path / "lm.jsonl")
+    cfg = LMConfig(epochs=1, batch_size=8, seq_len=32, vocab_size=64,
+                   num_layers=1, d_model=32, num_heads=2,
+                   synth_tokens=2048, print_freq=4, seed=0,
+                   audit="record", ledger_path=path)
+    LMTrainer(cfg).fit()
+    records = [json.loads(ln) for ln in open(path)]
+    audits = [r for r in records if r["event"] == "audit"]
+    assert len(audits) == 1, audits         # one program: train_step
+    (ev,) = audits
+    assert ev["program"] == "train_step" and ev["mode"] == "record"
+    assert ev["findings"] == 0              # the shipped program is clean
+    # the fixed-shape step never trips the sentry: no PL005 events
+    assert all((r.get("detail") or [{}])[0].get("check") != "PL005"
+               for r in audits)

@@ -381,11 +381,11 @@ class UnknownMeshAxis(Rule):
     SPEC_CTORS = {"P", "PartitionSpec"}
     AXIS_ARG_CALLS = {"psum", "pmean", "pmax", "pmin", "all_gather",
                       "all_to_all", "ppermute", "axis_index", "pbroadcast",
-                      "psum_scatter"}
+                      "psum_scatter", "axis_size"}
 
     _GATE_RE = re.compile(r"PartitionSpec|P\(|psum|pmean|pmax|pmin|"
                           r"all_gather|all_to_all|ppermute|axis_index|"
-                          r"pbroadcast")
+                          r"pbroadcast|axis_size|\.shape\[")
 
     def check(self, ctx: FileContext, project: Project) -> List[Finding]:
         axes = project.mesh_axes
@@ -400,14 +400,25 @@ class UnknownMeshAxis(Rule):
                                                   call.keywords]):
                     self._validate(lit, axes, ctx, out, "PartitionSpec")
             elif tname in self.AXIS_ARG_CALLS:
-                # axis_index(axis_name) takes the axis FIRST; the psum
-                # family takes (value, axis_name)
-                pos = 0 if tname == "axis_index" else 1
+                # axis_index/axis_size(axis_name) take the axis FIRST;
+                # the psum family takes (value, axis_name)
+                pos = 0 if tname in ("axis_index", "axis_size") else 1
                 cands = list(call.args[pos:pos + 1]) + [
                     k.value for k in call.keywords
                     if k.arg in ("axis_name", "axis")]
                 for lit in self._axis_literals(cands):
                     self._validate(lit, axes, ctx, out, f"{tname}()")
+        # mesh.shape["axis"] — Mesh.shape is keyed by axis NAME; a typo'd
+        # key raises KeyError only when the serving path first sizes the
+        # axis on hardware (array .shape subscripts are ints, never str)
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Attribute)
+                    and node.value.attr == "shape"
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                self._validate(node.slice, axes, ctx, out,
+                               f"{dotted_name(node.value)}[...]")
         return out
 
     def _axis_literals(self, nodes) -> Iterable[ast.Constant]:
@@ -1166,11 +1177,156 @@ class SignalHandlerHygiene(Rule):
                 "chain it from yours"))
 
 
+# ------------------------------------------------------------------ DL201
+class DivergentBranchCollectives(Rule):
+    uses_graph = True
+    id = "DL201"
+    title = "cond/switch branches issue divergent collective sequences"
+    rationale = ("under SPMD every process must execute the SAME ordered "
+                 "collective sequence; if lax.cond branches disagree (psum "
+                 "then pmax vs pmax then psum, or a collective in one arm "
+                 "only) any per-process predicate divergence pairs "
+                 "mismatched collectives across hosts and the pod "
+                 "deadlocks — the MPI matching rule, provable statically")
+
+    # primitives that rendezvous across processes when traced: the jaxpr
+    # half of this check lives in tpu_dist/analysis/proglint.py (PL002);
+    # this is the source-level prover over the same failure class
+    COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                   "all_to_all", "ppermute", "pbroadcast", "psum_scatter",
+                   "axis_index"}
+    _BRANCH_CALLS = {"cond", "switch"}
+
+    def check(self, ctx: FileContext, project: Project) -> List[Finding]:
+        if "cond" not in ctx.src and "switch" not in ctx.src:
+            return []
+        out: List[Finding] = []
+        with graph_scope(project, ctx) as g:
+            for node in g.file_nodes(ctx.rel):
+                root = ctx.tree if node.name == "<module>" else node.node
+                if root is None:
+                    continue
+                for call in _calls_same_scope(root):
+                    if terminal_name(call.func) in self._BRANCH_CALLS:
+                        self._check_site(call, node, g, ctx, out)
+        return out
+
+    def _check_site(self, call: ast.Call, encl, g, ctx: FileContext,
+                    out: List[Finding]) -> None:
+        tname = terminal_name(call.func)
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        if tname == "cond":
+            branches = list(call.args[1:3])
+            for name in ("true_fun", "false_fun"):
+                if name in kw:
+                    branches.append(kw[name])
+            labels = ("true branch", "false branch")
+            if len(branches) != 2:
+                return
+        else:
+            seq_arg = (call.args[1] if len(call.args) > 1
+                       else kw.get("branches"))
+            if not isinstance(seq_arg, (ast.Tuple, ast.List)):
+                return
+            branches = list(seq_arg.elts)
+            labels = tuple(f"branch[{i}]" for i in range(len(branches)))
+            if len(branches) < 2:
+                return
+        seqs = []
+        for b in branches:
+            seq = self._branch_sequence(b, encl, g)
+            if seq is None:
+                return   # unresolvable callable: stay silent, no guess
+            seqs.append(seq)
+        if len(set(seqs)) <= 1 or not any(seqs):
+            return
+        desc = "; ".join(f"{lab} {self._fmt(s)}"
+                         for lab, s in zip(labels, seqs))
+        out.append(self.finding(
+            ctx, call,
+            f"lax.{tname} branches issue different ordered collective "
+            f"sequences ({desc}); a process taking the other branch "
+            "pairs mismatched collectives across hosts and the pod "
+            "deadlocks — make every branch issue the identical sequence "
+            "(pad with the same collectives on a zero operand if needed)"))
+
+    def _branch_sequence(self, node: ast.AST, encl, g,
+                         _depth: int = 0,
+                         _seen: Optional[Set[str]] = None):
+        """Ordered (collective, axes...) tuples a branch callable issues,
+        or None when the callable cannot be resolved. Name/Attribute refs
+        resolve through the call graph (one level of helper recursion,
+        cycle-guarded); lambdas and functools.partial heads inline."""
+        if _seen is None:
+            _seen = set()
+        if isinstance(node, ast.Lambda):
+            return self._sequence(node, encl, g, _depth, _seen)
+        if (isinstance(node, ast.Call)
+                and terminal_name(node.func) == "partial" and node.args):
+            return self._branch_sequence(node.args[0], encl, g,
+                                         _depth, _seen)
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            targets, _ = g.resolve(encl, dotted_name(node))
+            for t in targets:
+                fn = g.funcs.get(t)
+                if fn is not None and fn.node is not None:
+                    if t in _seen:
+                        return ()
+                    _seen.add(t)
+                    return self._sequence(fn.node, fn, g, _depth, _seen)
+        return None
+
+    def _sequence(self, root: ast.AST, owner, g, depth: int,
+                  seen: Set[str]) -> tuple:
+        calls = sorted(_calls_same_scope(root),
+                       key=lambda c: (c.lineno, c.col_offset))
+        seq: List[tuple] = []
+        for c in calls:
+            tn = terminal_name(c.func)
+            if tn in self.COLLECTIVES:
+                seq.append((tn,) + self._axes(c, tn))
+            elif depth < 1 and owner is not None:
+                targets, _ = g.resolve(owner, dotted_name(c.func))
+                for t in targets:
+                    fn = g.funcs.get(t)
+                    if fn is not None and fn.node is not None \
+                            and t not in seen:
+                        seen.add(t)
+                        seq.extend(self._sequence(fn.node, fn, g,
+                                                  depth + 1, seen))
+                        break
+        return tuple(seq)
+
+    def _axes(self, call: ast.Call, tname: str) -> tuple:
+        pos = 0 if tname in ("axis_index", "axis_size") else 1
+        cands = list(call.args[pos:pos + 1]) + [
+            k.value for k in call.keywords
+            if k.arg in ("axis_name", "axis", "axes")]
+        out: List[str] = []
+
+        def walk(nodes) -> None:
+            for n in nodes:
+                if isinstance(n, (ast.Tuple, ast.List)):
+                    walk(n.elts)
+                elif isinstance(n, ast.Constant) and isinstance(n.value,
+                                                                str):
+                    out.append(n.value)
+        walk(cands)
+        return tuple(out)
+
+    def _fmt(self, seq: tuple) -> str:
+        if not seq:
+            return "[no collectives]"
+        return "[" + " -> ".join(
+            f"{s[0]}({','.join(s[1:])})" for s in seq) + "]"
+
+
 RULES: List[Rule] = [HostDivergentCollectives(), HotLoopHostSync(),
                      UnknownMeshAxis(), TracedSideEffect(), PrngHygiene(),
                      LedgerSchema(), DonatedBufferReuse(),
                      HotLoopDevicePut(),
                      SignalLockDeadlock(), BlockingIoUnderLock(),
-                     NonDaemonThreadNoJoin(), SignalHandlerHygiene()]
+                     NonDaemonThreadNoJoin(), SignalHandlerHygiene(),
+                     DivergentBranchCollectives()]
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in RULES}

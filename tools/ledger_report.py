@@ -417,6 +417,43 @@ def _counter_trend(kv, num_key, den_key):
     return trend
 
 
+def audit_section(records, out=print):
+    """Program-audit rollup (``audit`` events — analysis.proglint via
+    plan.compile): per-program unwaivered/waived finding counts and the
+    check ids involved. None when the run predates the audit knob or
+    ran with audit=none."""
+    audits = [r for r in records if r["event"] == "audit"]
+    if not audits:
+        return None
+    progs = {}
+    for r in audits:
+        p = progs.setdefault(r.get("program") or "?",
+                             {"events": 0, "findings": 0, "waived": 0,
+                              "checks": []})
+        p["events"] += 1
+        p["findings"] += r.get("findings") or 0
+        p["waived"] += r.get("waived") or 0
+        for d in (r.get("detail") or ()):
+            c = d.get("check")
+            if c and c not in p["checks"]:
+                p["checks"].append(c)
+    for p in progs.values():
+        p["checks"].sort()
+    total = sum(p["findings"] for p in progs.values())
+    waived = sum(p["waived"] for p in progs.values())
+    mode = audits[-1].get("mode") or "record"
+    out(f"\naudit ({mode}): {len(progs)} program(s), {total} unwaivered "
+        f"finding(s), {waived} waived")
+    for name in sorted(progs):
+        p = progs[name]
+        if p["findings"] or p["waived"]:
+            out(f"  {name}: {p['findings']} finding(s)"
+                + (f" + {p['waived']} waived" if p["waived"] else "")
+                + (f" [{', '.join(p['checks'])}]" if p["checks"] else ""))
+    return {"mode": mode, "programs": {n: progs[n] for n in sorted(progs)},
+            "findings": total, "waived": waived}
+
+
 def requests_section(records, out=print):
     """Per-request tracing rollup (obs.reqtrace ``span`` events): the
     waterfall summary, the tail-latency attribution table with its
@@ -618,6 +655,9 @@ def summarize(records, out=print):
     # serving-SLO view over decode events (generate / decode_bench)
     summary["decode"] = decode_section(records, out=out)
     summary["requests"] = requests_section(records, out=out)
+    # program-audit verdicts (analysis.proglint): which step/serve
+    # programs were audited and what survived the waiver file
+    summary["audit"] = audit_section(records, out=out)
 
     if skews:
         worst = max(skews, key=lambda r: r["spread_s"])

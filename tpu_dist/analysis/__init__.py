@@ -1,0 +1,8 @@
+"""Program-level analysis (tpu_dist.analysis).
+
+Home of :mod:`tpu_dist.analysis.proglint`, the jaxpr/compiled-program
+auditor — the post-trace complement of the source-level tools/distlint.
+Kept lazy (no submodule imports here) so `import tpu_dist.analysis`
+stays jax-free; the auditor itself imports jax only inside the tracing
+helpers.
+"""

@@ -382,6 +382,11 @@ class LMTrainer:
         # (obs.RunObs) — the LM engine's step records carry tok/s + MFU
         self.obs = RunObs("lm", cfg, self.mesh, unit="tok/s",
                           plan_info=self._plan_info)
+        # program audit (tpu_dist.analysis.proglint via plan.compile):
+        # armed here so the compile-time pass and the drain-boundary
+        # recompile sentry see every program this run builds
+        from tpu_dist.plan.compile import set_audit
+        set_audit(cfg.audit, self.obs.ledger)
         # whether the int8 matmuls route through the fused Pallas kernel
         # (ops.pallas_quant) — trace-time static, so ONE read here is the
         # truth for every step record; ledger_report attributes MFU deltas
@@ -716,6 +721,10 @@ class LMTrainer:
                                     grad_norm=gn, update_norm=un, n_steps=k)
         pending.clear()
         self.obs.heartbeat()  # watchdog: device progress proven at this sync
+        # recompile sentry (PL005): a host-only trace-cache counter read
+        # at the sanctioned boundary — no device sync rides on it
+        from tpu_dist.plan.compile import check_audit_sentry
+        check_audit_sentry()
 
     def _meter_fields(self):
         fields = [("Time", "6.3f"), ("Data", "6.3f"), ("Loss", ".4e"),
@@ -798,15 +807,23 @@ class LMTrainer:
                 # first would compile the step twice (telemetry.py
                 # contract); same-iteration probing keeps the column on
                 # single-dispatch runs
+                from tpu_dist.plan.compile import audit_mode, audit_program
                 from tpu_dist.utils.telemetry import program_stats
                 st = program_stats(self.train_step, self.state, inputs_d,
                                    targets_d, self.rng,
-                                   with_hlo=bool(self.obs.ledger.path))
+                                   with_hlo=bool(self.obs.ledger.path)
+                                   or audit_mode() != "none")
                 self._program_hbm = st["hbm_bytes"] or False
                 self.obs.ledger.emit(
                     "compile", program="train_step",
                     seconds=warm_secs or None,
                     hbm_bytes=st["hbm_bytes"], flops=st["flops"])
+                # compile-time audit pass against the SAME lowered
+                # artifact (plan.compile.audit_program) — a no-op under
+                # audit=none, one 'audit' ledger event per program else
+                audit_program("train_step", self.train_step, self.state,
+                              inputs_d, targets_d, self.rng,
+                              hlo=st.get("hlo"), precision=cfg.precision)
                 if st.get("hlo"):
                     # static cost attribution of the same executable (one
                     # lower for hbm/flops/buckets — obs.attr roofline)
@@ -907,15 +924,21 @@ class LMTrainer:
             if self._program_hbm is None:
                 # post-dispatch probe (same iteration, so single-window
                 # runs record it too): see telemetry.program_stats
+                from tpu_dist.plan.compile import audit_mode, audit_program
                 from tpu_dist.utils.telemetry import program_stats
                 st = program_stats(self.window_step, self.state,
                                    self._train_rows_dev, idx_dev, self.rng,
-                                   with_hlo=bool(self.obs.ledger.path))
+                                   with_hlo=bool(self.obs.ledger.path)
+                                   or audit_mode() != "none")
                 self._program_hbm = st["hbm_bytes"] or False
                 self.obs.ledger.emit(
                     "compile", program="window_step",
                     seconds=warm_secs or None,
                     hbm_bytes=st["hbm_bytes"], flops=st["flops"])
+                # same-artifact compile-time audit (plan.compile)
+                audit_program("window_step", self.window_step, self.state,
+                              self._train_rows_dev, idx_dev, self.rng,
+                              hlo=st.get("hlo"), precision=cfg.precision)
                 if st.get("hlo"):
                     # static cost attribution (obs.attr), same executable
                     from tpu_dist.obs.attr import emit_cost_model

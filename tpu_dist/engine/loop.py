@@ -436,6 +436,11 @@ class Trainer:
         # watchdog, wired from cfg (obs.RunObs); a pathless ledger is free
         self.obs = RunObs("image", cfg, self.mesh, unit="img/s",
                           plan_info=self._plan_info)
+        # program audit (tpu_dist.analysis.proglint via plan.compile):
+        # armed here so the compile-time pass and the drain-boundary
+        # recompile sentry see every program this run builds
+        from tpu_dist.plan.compile import set_audit
+        set_audit(cfg.audit, self.obs.ledger)
         # whether int8 matmuls (vit_* quant archs) route through the fused
         # Pallas kernel — trace-time static; stamped into step records so
         # ledger_report can attribute MFU deltas (LMTrainer twin)
@@ -512,6 +517,10 @@ class Trainer:
                                     grad_norm=gn, update_norm=un, n_steps=n)
         pending.clear()
         self.obs.heartbeat()  # watchdog: device progress proven at this sync
+        # recompile sentry (PL005): a host-only trace-cache counter read
+        # at the sanctioned boundary — no device sync rides on it
+        from tpu_dist.plan.compile import check_audit_sentry
+        check_audit_sentry()
 
     def _apply_nan_fault(self) -> None:
         """The ``nan_batch`` injection effect (obs.faults): pixel inputs
@@ -629,15 +638,23 @@ class Trainer:
                 # the step twice (utils.telemetry.program_stats contract) —
                 # and probing post-dispatch in the SAME iteration means
                 # even a single-dispatch run still records the column
+                from tpu_dist.plan.compile import audit_mode, audit_program
                 from tpu_dist.utils.telemetry import program_stats
                 st = program_stats(self.train_step, self.state, images,
                                    labels, self.rng,
-                                   with_hlo=bool(self.obs.ledger.path))
+                                   with_hlo=bool(self.obs.ledger.path)
+                                   or audit_mode() != "none")
                 self._program_hbm = st["hbm_bytes"] or False
                 self._program_flops = st["flops"]
                 self.obs.ledger.emit("compile", program="train_step",
                                      hbm_bytes=st["hbm_bytes"],
                                      flops=st["flops"])
+                # compile-time audit pass against the SAME lowered
+                # artifact (plan.compile.audit_program) — a no-op under
+                # audit=none, one 'audit' ledger event per program else
+                audit_program("train_step", self.train_step, self.state,
+                              images, labels, self.rng, hlo=st.get("hlo"),
+                              precision=cfg.precision)
                 if st.get("hlo"):
                     # static cost attribution of the same executable (one
                     # lower for hbm/flops/buckets — obs.attr); feeds the
@@ -783,16 +800,22 @@ class Trainer:
                 # runs record it too): see telemetry.program_stats; the
                 # cost model counts the scan body once, so flops ~= ONE
                 # optimizer step of the window program
+                from tpu_dist.plan.compile import audit_mode, audit_program
                 from tpu_dist.utils.telemetry import program_stats
                 args = ((*self._train_data_dev, dev_payload, self.rng)
                         if self.device_data else (*dev_payload, self.rng))
                 st = program_stats(self.window_step, self.state, *args,
-                                   with_hlo=bool(self.obs.ledger.path))
+                                   with_hlo=bool(self.obs.ledger.path)
+                                   or audit_mode() != "none")
                 self._program_hbm = st["hbm_bytes"] or False
                 self._program_flops = st["flops"]
                 self.obs.ledger.emit("compile", program="window_step",
                                      hbm_bytes=st["hbm_bytes"],
                                      flops=st["flops"])
+                # same-artifact compile-time audit (plan.compile)
+                audit_program("window_step", self.window_step, self.state,
+                              *args, hlo=st.get("hlo"),
+                              precision=cfg.precision)
                 if st.get("hlo"):
                     # static cost attribution (obs.attr), same executable
                     from tpu_dist.obs.attr import emit_cost_model

@@ -82,6 +82,7 @@ from tpu_dist.engine.generate import (_quantize_for_decode, _refuse_wo_tree,
 from tpu_dist.engine.kv_cache import PagedKVPool, PrefixMatch
 from tpu_dist.obs.reqtrace import RequestTracer
 from tpu_dist.ops.paged_attention import cow_fork_pages
+from tpu_dist.plan.compile import check_audit_sentry, register_audit_program
 
 
 @dataclass
@@ -777,6 +778,11 @@ class ServeEngine:
         padded[0, :p] = prompt
         program = _prefill_program(self.model, self.cfg.temperature,
                                    self.cfg.top_k, self.cfg.top_p)
+        # recompile sentry (analysis.proglint PL005): prefill specializes
+        # per bucket BY DESIGN, so its allowed trace-cache size is the
+        # bucket-ladder length, not 1 (no-op when the audit is off)
+        register_audit_program("serve_prefill", program,
+                               allowed=len(self.buckets))
         tok, new_layers, self._rng = program(
             self.params, self.pool.layers(), jnp.asarray(bt[None]),
             jnp.int32(p), jnp.int32(shared_len), jnp.asarray(padded),
@@ -871,6 +877,9 @@ class ServeEngine:
             bts[i] = s.block_table
         program = _tick_program(self.model, self.cfg.temperature,
                                 self.cfg.top_k, self.cfg.top_p)
+        # tick shapes are occupancy-invariant (inactive slots ride the
+        # trash page), so ANY cache growth is a retrace hazard: allowed=1
+        register_audit_program("serve_tick", program)
         nxt, new_layers, self._rng = program(
             self.params, self.pool.layers(), jnp.asarray(bts),
             jnp.asarray(tokens), jnp.asarray(positions), self._rng)
@@ -915,6 +924,8 @@ class ServeEngine:
             caps[i] = s.prompt_len + s.req.max_new_tokens
             bts[i] = s.block_table
         program = _spec_tick_program(self.model, self.draft_model, k)
+        # same occupancy-invariance as the plain tick: allowed=1
+        register_audit_program("serve_spec_tick", program)
         emitted, emit_n, new_layers, new_dlayers = program(
             self.params, self.draft_params, self.pool.layers(),
             self.draft_pool.layers(), jnp.asarray(bts),
@@ -968,6 +979,9 @@ class ServeEngine:
         s.win_ticks = s.win_tokens = s.win_drafted = 0
 
     def _emit_kv_cache(self) -> None:
+        # serving's drain boundary: the periodic pressure snapshot — the
+        # recompile sentry's host-only counter read rides it (PL005)
+        check_audit_sentry()
         if self.ledger is None:
             return
         st = self.pool.stats()

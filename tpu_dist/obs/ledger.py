@@ -155,6 +155,13 @@ EVENT_SCHEMA = {
     # the search's identity — candidate count and the winning plan hash
     # per device kind; workload/measured extras ride along
     "tune": ("device_kind", "candidates", "best_hash"),
+    # one program-audit verdict (tpu_dist.analysis.proglint through
+    # plan.compile's audit pass): program names the jitted step/serve
+    # program, mode the knob (record|halt), findings the UNWAIVERED
+    # finding count (0 = clean); waived and detail (the finding dicts)
+    # ride as extras. One event per program at its compile-time pass,
+    # plus one latched event per program the recompile sentry catches
+    "audit": ("program", "mode", "findings"),
     # run rollup: total steps, wall seconds, best metric in extras;
     # status ("ok"|"crashed"|"interrupted") rides as an extra stamped by
     # RunObs.run_end — the crash-safe shutdown path sets "crashed"

@@ -405,6 +405,13 @@ def metrics_ledger_sink(reg: MetricsRegistry):
                                  "fleet-wide SLO breaches (monotonic; "
                                  "fed by deltas of the fleet events' "
                                  "cumulative count)")
+    # program-audit findings (tpu_dist.analysis.proglint 'audit' events)
+    # by check id; pre-registered so a clean run still scrapes zeros
+    audit_findings = reg.counter("tpu_dist_audit_findings_total",
+                                 "unwaivered program-audit findings "
+                                 "(analysis.proglint), by check")
+    for c in ("PL001", "PL002", "PL003", "PL004", "PL005"):
+        audit_findings.labels(check=c)
     # fleet events carry the CUMULATIVE count; a Prometheus counter must
     # only move forward, so the sink feeds it deltas
     fleet_breach_seen = [0.0]
@@ -521,6 +528,11 @@ def metrics_ledger_sink(reg: MetricsRegistry):
                 degraded_g.set(1.0)
             elif act == "expand":
                 degraded_g.set(0.0)
+        elif ev == "audit":
+            for d in (rec.get("detail") or ()):
+                if not d.get("waived"):
+                    audit_findings.labels(
+                        check=d.get("check") or "unknown").inc()
         elif ev == "fleet":
             if rec.get("hosts_live") is not None:
                 fleet_hosts.set(rec["hosts_live"])

@@ -121,18 +121,126 @@ def _pass_validate(plan: Plan, binds: Bindings) -> None:
         raise PlanError("the image templates need a transform binding")
 
 
+# ---- program audit (tpu_dist.analysis.proglint) ---------------------------
+# A module-level switch in the activate_plan mold: the engines arm it from
+# cfg.audit before their first dispatch, the partition helpers below
+# REGISTER every program they mint as a side effect (never a wrapper — an
+# attribute hop would take the builders out of distlint's jit-factory
+# fixpoint and DL002's hot-loop derivation with it), and the engines run
+# the compile-time pass at the same first-dispatch probe that already
+# lowers the program for telemetry. The runtime half (the recompile
+# sentry) is a host-only counter read at the drain boundaries.
+
+AUDIT_MODES = ("none", "record", "halt")
+
+_AUDIT = {"mode": "none", "ledger": None, "sentry": None}
+
+
+def set_audit(mode: str, ledger=None) -> None:
+    """Arm (or disarm) the program audit for this process. ``record``
+    emits ``audit`` ledger events; ``halt`` additionally raises
+    :class:`~tpu_dist.analysis.proglint.AuditError` on any unwaivered
+    finding. A fresh sentry per call: each run watches its own caches."""
+    mode = mode or "none"
+    if mode not in AUDIT_MODES:
+        raise ValueError(f"audit={mode!r}: pick one of {AUDIT_MODES}")
+    _AUDIT["mode"], _AUDIT["ledger"] = mode, ledger
+    if mode == "none":
+        _AUDIT["sentry"] = None
+    else:
+        from tpu_dist.analysis.proglint import RecompileSentry
+
+        _AUDIT["sentry"] = RecompileSentry()
+
+
+def audit_mode() -> str:
+    return _AUDIT["mode"]
+
+
+def register_audit_program(program: str, fn, allowed: int = 1) -> None:
+    """Put a jitted program under the recompile sentry (PL005).
+    ``allowed`` is its legal trace-cache size — 1 for fixed-shape step
+    programs, the bucket count for deliberately shape-specializing ones
+    (serve prefill). No-op when the audit is off."""
+    if _AUDIT["sentry"] is not None:
+        _AUDIT["sentry"].register(program, fn, allowed)
+
+
+def _emit_audit(program: str, findings) -> None:
+    led = _AUDIT["ledger"]
+    if led is not None:
+        led.emit("audit", program=program, mode=_AUDIT["mode"],
+                 findings=len([f for f in findings if not f.waived]),
+                 waived=len([f for f in findings if f.waived]),
+                 detail=[f.to_json() for f in findings] or None)
+
+
+def audit_program(program: str, fn, *args, hlo=None, precision=None,
+                  allowed: int = 1):
+    """The compile-time pass over ONE program: retrace abstractly
+    (make_jaxpr — no compile, no execution), run the jaxpr checks, check
+    donation against the caller's already-compiled HLO text (the
+    telemetry.program_stats artifact — zero extra lowering), register
+    the program with the sentry, and emit exactly one ``audit`` ledger
+    event. Returns the (waiver-applied) findings; raises AuditError
+    under ``halt`` when any survive."""
+    if _AUDIT["mode"] == "none":
+        return []
+    from tpu_dist.analysis import proglint
+
+    register_audit_program(program, fn, allowed)
+    closed = jax.make_jaxpr(fn)(*args)
+    findings = proglint.audit_jaxpr(program, closed,
+                                    precision=precision, hlo=hlo)
+    waivers, meta = proglint.load_waivers()
+    findings = proglint.apply_waivers(findings, waivers) + meta
+    _emit_audit(program, findings)
+    bad = proglint.unwaivered(findings)
+    if bad and _AUDIT["mode"] == "halt":
+        raise proglint.AuditError(
+            "audit=halt: " + "; ".join(f.render() for f in bad))
+    return findings
+
+
+def check_audit_sentry() -> None:
+    """The drain-boundary PL005 check: one host-side ``_cache_size``
+    read per registered program (no device sync — DL002 stays clean).
+    Findings latch per program, so ``record`` emits exactly one
+    ``audit`` event per offender; ``halt`` raises on unwaivered ones."""
+    sentry = _AUDIT["sentry"]
+    if sentry is None:
+        return
+    findings = sentry.check()
+    if not findings:
+        return
+    from tpu_dist.analysis import proglint
+
+    waivers, _ = proglint.load_waivers()
+    findings = proglint.apply_waivers(findings, waivers)
+    for f in findings:
+        _emit_audit(f.program, [f])
+    bad = proglint.unwaivered(findings)
+    if bad and _AUDIT["mode"] == "halt":
+        raise proglint.AuditError(
+            "audit=halt: " + "; ".join(f.render() for f in bad))
+
+
 # ---- pass 4 helpers: partition --------------------------------------------
 
 def _jit_gspmd(fn, in_shardings, out_shardings, donate: bool):
-    return jax.jit(fn, in_shardings=in_shardings,
-                   out_shardings=out_shardings,
-                   donate_argnums=(0,) if donate else ())
+    jf = jax.jit(fn, in_shardings=in_shardings,
+                 out_shardings=out_shardings,
+                 donate_argnums=(0,) if donate else ())
+    register_audit_program(getattr(fn, "__name__", "step"), jf)
+    return jf
 
 
 def _shard_map_jit(fn, mesh, in_specs, out_specs, donate: bool):
     sharded = shard_map(fn, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    jf = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    register_audit_program(getattr(fn, "__name__", "step"), jf)
+    return jf
 
 
 # ---- image lowerings ------------------------------------------------------
