@@ -54,6 +54,14 @@ def main():
                     help="after training, greedy-decode N tokens from the "
                          "trained model and report how often they follow "
                          "the synthetic affine rule")
+    ap.add_argument("--serve", type=int, default=0,
+                    help="after training, stand up the long-context "
+                         "ServeEngine (chunked prefill + sp-sharded paged "
+                         "KV pool) on the SAME devices the sequence axis "
+                         "trained on and serve N short requests plus one "
+                         "long prompt: chunked when training ran without "
+                         "sp, sequence-parallel prefill into the sharded "
+                         "pool when it did")
     args = ap.parse_args()
 
     from tpu_dist.parallel import launch
@@ -110,7 +118,7 @@ def main():
         print(f"throughput {trainer.last_tok_s:,.0f} tokens/sec "
               f"({trainer.mode}) best_ppl {best_ppl:.2f}")
 
-    if args.generate:
+    if args.generate or args.serve:
         # decode on host-replicated params; the gather is a COLLECTIVE for
         # cross-host sharded modes, so EVERY process enters it — only the
         # decode itself is process-0-only. pp's stacked layout is restored
@@ -142,6 +150,72 @@ def main():
                       for i in range(1, n + 1))
         print(f"generated {n} tokens, {follows}/{n} follow the affine rule: "
               f"{out[0].tolist()}")
+
+    if args.serve and jax.process_index() == 0:
+        # the serving half of the long-context story: the engine stands up
+        # on the SAME local devices the model's sequence axis trained on.
+        # With --mesh ...,seq=N the KV pool shards its page arenas over an
+        # ('sp',) submesh of those N devices and long prompts prefill
+        # sequence-parallel (ring attention, scattered KV writes); without
+        # sp the long prompt goes through chunked prefill instead — either
+        # way short requests keep decoding in between.
+        from tpu_dist.engine.serve import (DecodeRequest, ServeConfig,
+                                           ServeEngine)
+        from tpu_dist.parallel.mesh import SEQ_AXIS, SP_AXIS, make_mesh
+
+        if trainer.use_pp:
+            print("--serve: pipeline-stacked params don't decode through "
+                  "the serving engine (use --generate's dense restore)")
+            return
+        sp_n = (int(trainer.mesh.shape[SEQ_AXIS])
+                if trainer.use_sp else 1)
+        sp_n = min(sp_n, len(jax.local_devices()))
+        page_size = 8
+        if sp_n > 1 and cfg.seq_len < 2 * sp_n * page_size:
+            print(f"--serve: seq_len {cfg.seq_len} too short for a "
+                  f"{sp_n}-device sp pool; serving chunked on one device")
+            sp_n = 1
+        step = sp_n * page_size
+        serve_len = (cfg.seq_len // step) * step
+        serve_model = (trainer._sp_ctor() if trainer.use_sp
+                       else trainer.model)
+        mesh = (make_mesh((sp_n,), (SP_AXIS,),
+                          devices=jax.local_devices()[:sp_n])
+                if sp_n > 1 else None)
+        thresh = serve_len // 2
+        scfg = ServeConfig(
+            max_slots=4, page_size=page_size,
+            num_pages=4 * (serve_len // page_size), max_len=serve_len,
+            prefill_chunk=2 * page_size,
+            sp_prefill_threshold=thresh if mesh is not None else 0)
+        eng = ServeEngine(serve_model, host_params, scfg, mesh=mesh)
+
+        def affine(seed, n):
+            toks = [seed % trainer.vocab_size]
+            for _ in range(n - 1):
+                toks.append((toks[-1] * 5 + 7) % trainer.vocab_size)
+            return np.asarray(toks, np.int32)
+
+        long_len = thresh if mesh is not None else serve_len // 2
+        reqs = [DecodeRequest(0, affine(3, long_len), 8)]
+        reqs += [DecodeRequest(i + 1, affine(3 + i, 6), 8)
+                 for i in range(args.serve)]
+        comps = eng.run(reqs)
+        follows = total = 0
+        for c in comps:
+            toks = [int(t) for t in c.tokens]
+            gen0 = c.prompt_len  # first generated index
+            follows += sum(toks[i + 1] == (toks[i] * 5 + 7)
+                           % trainer.vocab_size
+                           for i in range(gen0 - 1, len(toks) - 1))
+            total += len(toks) - gen0
+        st = eng.stats()
+        print(f"served {len(comps)}/{len(reqs)} requests "
+              f"(1 long {long_len}-token prompt + {args.serve} short) on "
+              f"{sp_n} device(s): {st['sp_prefills']} sp prefills, "
+              f"{st['chunk_ticks']} chunk ticks, occupancy "
+              f"{st['occupancy'] * 100:.0f}%, {follows}/{total} generated "
+              "tokens follow the affine rule")
 
 
 if __name__ == "__main__":

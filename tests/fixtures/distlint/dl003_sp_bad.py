@@ -1,0 +1,28 @@
+"""DL003 positive fixture (sp serving-parallel spellings): the
+long-context serving plane's 'sp' axis, misspelled at every call-site
+shape the sharded pool actually uses — gather psum, axis_index
+ownership tests, and mesh.shape sizing."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def bad_gather(pages):
+    # the sp page-gather's replication psum over a typo'd axis
+    return jax.lax.psum(pages, "spp")
+
+
+def bad_ownership():
+    # the local-block-table ownership test against a typo'd axis
+    return jax.lax.axis_index("sp_serve")
+
+
+def bad_pool_width(mesh, cfg):
+    # per-device page budget sized off an undeclared axis name
+    n = mesh.shape["sq"]
+    return cfg.num_pages // n
+
+
+def bad_arena_spec(arena):
+    # the arena sharding spec: 'sp' misspelled in PartitionSpec
+    return P("spd"), arena

@@ -1,0 +1,23 @@
+"""DL003 negative fixture (sp serving-parallel spellings): the same
+call-site shapes spelled against the DECLARED 'sp' axis — the authority
+learned it from parallel/mesh.py the moment SP_AXIS landed there."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def good_gather(pages):
+    return jax.lax.psum(pages, "sp")
+
+
+def good_ownership():
+    return jax.lax.axis_index("sp")
+
+
+def good_pool_width(mesh, cfg):
+    n = mesh.shape["sp"]
+    return cfg.num_pages // n
+
+
+def good_arena_spec(arena):
+    return P("sp"), arena

@@ -17,6 +17,10 @@ def emit_serving_badly(ledger):
     # round 11: the serving events (engine.serve) are schema-checked too
     ledger.emit("request", rid=7, tokens=12)   # missing the timeline fields
     ledger.emit("kv_cache", pages_free=3)      # missing used/active_seqs
+    # round 19: a pre-long-context snapshot shape — missing the now-
+    # required sharded_devices/chunks_pending serving-plane fields
+    ledger.emit("kv_cache", pages_free=3, pages_used=13, active_seqs=4,
+                shared_pages=2, cow_copies=1, prefix_hits=6)
 
 
 def emit_scale_badly(ledger):

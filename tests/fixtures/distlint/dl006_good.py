@@ -28,11 +28,13 @@ def emit_serving_well(ledger):
                 prompt_len=8, ttft_s=0.5)
     # round 16: the pressure snapshot carries the prefix-sharing and
     # speculative-acceptance counters (shared/cow/hits required; the
-    # spec_* trend fields ride as extras)
+    # spec_* trend fields ride as extras); round 19 adds the sp-sharded
+    # pool width and the chunked-prefill backlog as required fields
     ledger.emit("kv_cache", pages_free=3, pages_used=13, active_seqs=4,
                 shared_pages=2, cow_copies=1, prefix_hits=6,
+                sharded_devices=4, chunks_pending=2,
                 pages_total=16, high_water_used=16, slots=4, tick=40,
-                spec_emitted=80, spec_slot_ticks=40)
+                spec_emitted=80, spec_slot_ticks=40, chunk_ticks=12)
 
 
 def emit_scale_well(ledger):

@@ -42,7 +42,7 @@ def _full_lint():
 # an extra finding is a false positive creeping into the rule, a missing
 # one is a detection regression; both should fail loudly here
 EXPECTED_BAD_COUNTS = {"DL001": 2, "DL002": 3, "DL003": 3,
-                       "DL004": 4, "DL005": 3, "DL006": 16, "DL007": 2,
+                       "DL004": 4, "DL005": 3, "DL006": 17, "DL007": 2,
                        "DL008": 2,
                        "DL101": 1, "DL102": 2, "DL103": 2, "DL104": 3,
                        "DL201": 4}
@@ -336,6 +336,21 @@ def test_dl003_serve_era_spellings_pair():
     assert any("axis_size()" in f.message and "dataa" in f.message
                for f in bad.findings)
     good = lint_files([os.path.join(FIXTURES, "dl003_serve_good.py")],
+                      select=["DL003"])
+    assert good.findings == [], [f.render() for f in good.findings]
+
+
+def test_dl003_sp_axis_spellings_pair():
+    """Satellite of PR 19: the 'sp' serving-sequence-parallel axis joined
+    the parallel/mesh.py authority, so the sharded-pool call-site shapes
+    (gather psum, axis_index ownership tests, mesh.shape sizing, arena
+    PartitionSpec) lint clean when spelled 'sp' and fire on every typo."""
+    bad = lint_files([os.path.join(FIXTURES, "dl003_sp_bad.py")],
+                     select=["DL003"])
+    assert len(bad.findings) == 4, [f.render() for f in bad.findings]
+    for typo in ("spp", "sp_serve", "sq", "spd"):
+        assert any(typo in f.message for f in bad.findings), typo
+    good = lint_files([os.path.join(FIXTURES, "dl003_sp_good.py")],
                       select=["DL003"])
     assert good.findings == [], [f.render() for f in good.findings]
 

@@ -273,6 +273,51 @@ def test_ledger_report_decode_section(tmp_path, capsys):
     assert any("latency p50" in ln for ln in lines)
 
 
+def test_ledger_report_serving_chunk_and_sharded_fields(capsys):
+    """Round 19 ledger half: the serving section renders chunk-prefill
+    occupancy (cumulative chunk_ticks over tick, first->last windows), the
+    chunk-queue depth gauge (max backlog / drained-or-not last), and the
+    sp-sharded pool's device count — all from the periodic kv_cache
+    snapshots the engine already emits (no-jax: pure dict arithmetic)."""
+    from tools.ledger_report import summarize
+
+    reqs = [{"event": "request", "ts": 1.0 + i, "rid": i, "tokens": 8,
+             "queue_wait_s": 0.01, "ttft_s": 0.02} for i in range(3)]
+    kv = [{"event": "kv_cache", "ts": 2.0, "tick": 10, "chunk_ticks": 8,
+           "chunks_pending": 6, "sharded_devices": 4, "active_seqs": 3,
+           "slots": 4, "pages_free": 10},
+          {"event": "kv_cache", "ts": 3.0, "tick": 20, "chunk_ticks": 12,
+           "chunks_pending": 2, "sharded_devices": 4, "active_seqs": 2,
+           "slots": 4, "pages_free": 12},
+          {"event": "kv_cache", "ts": 4.0, "tick": 30, "chunk_ticks": 12,
+           "chunks_pending": 0, "sharded_devices": 4, "active_seqs": 1,
+           "slots": 4, "pages_free": 20}]
+    lines = []
+    summary = summarize(reqs + kv, out=lines.append)
+    srv = summary["decode"]["serving"]
+    co = srv["chunk_occupancy"]
+    assert co["overall"] == pytest.approx(12 / 30)
+    assert co["first"] == pytest.approx(0.8)      # 8 chunks / 10 steps
+    assert co["last"] == pytest.approx(0.0)       # backlog drained
+    assert srv["chunks_pending_max"] == 6
+    assert srv["chunks_pending_last"] == 0
+    assert srv["sharded_devices"] == 4
+    txt = "\n".join(lines)
+    assert "chunked prefill: 40% of steps ran a chunk" in txt
+    assert "queue depth max 6, last 0" in txt
+    assert "sp-sharded KV pool: 4 devices" in txt
+    # unsharded single-device runs stay silent (no sp line, no chunk line
+    # when the counters never moved)
+    kv1 = [dict(k, sharded_devices=1, chunk_ticks=0) for k in kv]
+    lines = []
+    summary = summarize(reqs + kv1, out=lines.append)
+    srv = summary["decode"]["serving"]
+    assert srv["sharded_devices"] == 1
+    txt = "\n".join(lines)
+    assert "sp-sharded" not in txt
+    assert "chunked prefill" not in txt
+
+
 def test_trace_merge_two_attempt_lanes(job_dir):
     """The 2-attempt lane check: each attempt renders its own lane group,
     attempt 1 offset by its true wall distance, restart gap drawn."""
@@ -630,4 +675,78 @@ def test_decode_bench_trace_replay_cli(tmp_path):
     wp.write_text(json.dumps(worse))
     report = track(load_points([str(hp), str(wp)]), threshold_pct=5.0)
     assert report["metrics"][head["metric"]]["serving_regressed"]
+
+
+def test_decode_bench_long_context_acceptance_cli(tmp_path):
+    """ISSUE 19 acceptance, on the real CLI surface: the checked-in
+    mixed-traffic trace (tools/traces/longcontext_mix.json — 14 short chat
+    requests + one 16384-token admit in flight) replays through chunked
+    prefill under the virtual cost-model clock, and the headline JSON must
+    show (a) short-request TPOT p99 within 25% of the no-long-prompt
+    baseline — the whole point of chunking: interference is bounded by
+    chunk/tick_floor (128/1024 = 12.5%), not prompt_len/tick_floor
+    (1600%) — and (b) a context longer than ONE device's page budget
+    served end-to-end on a 4-device cpu sp submesh. Both numbers are
+    deterministic schedule arithmetic (virtual clock, seeded trace), so
+    the bounds are exact pins, not flaky wall-clock measurements.
+    bench_track then gates ttft_long_p99 and tpot_interference_pct like
+    data_s: lower is better, pre-long-context history abstains."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [_sys.executable, "tools/decode_bench.py",
+         "--long-context", "tools/traces/longcontext_mix.json",
+         "--vocab-size", "256", "--d-model", "32", "--num-layers", "1",
+         "--num-heads", "2", "--serve-slots", "4", "--page-size", "64",
+         "--prefill-chunk", "128", "--sp-capacity", "4"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    head = json.loads(out.stdout.strip().splitlines()[-1])
+    assert head["metric"] == "lm_longcontext_serving"
+    srv = head["serving"]
+    assert srv["mode"] == "long_context"
+    assert srv["requests"] == 15 and srv["completed"] == 15
+    assert srv["long_requests"] == 1
+    # (a) the interference pin: a 16k admit in flight costs the short
+    # requests' TPOT p99 at most 25% — chunked prefill's acceptance bound
+    assert srv["tpot_interference_pct"] is not None
+    assert srv["tpot_interference_pct"] <= 25.0, srv
+    assert srv["ttft_long_p99"] is not None and srv["ttft_long_p99"] > 0
+    assert srv["tpot_baseline_p99"] > 0
+    # the 16384-token prompt really went through the chunk path
+    assert srv["chunk_ticks"] >= 16384 // 128
+    # (b) the sp capacity pin: context > one device's page budget, served
+    sp = srv["sp_capacity"]
+    assert sp["exceeds_single_device"], sp
+    assert sp["context_tokens"] > sp["device_token_budget"]
+    assert sp["completed"] == 1 and sp["sp_prefills"] == 1
+    assert sp["devices"] == 4
+    # bench_track: both tail numbers gate lower-is-better with abstention
+    from tools.bench_track import load_points, track
+
+    hp = tmp_path / "head.json"
+    hp.write_text(json.dumps(head))
+    points = load_points([str(hp)])
+    assert points[0]["serving_ttfl"] == srv["ttft_long_p99"]
+    assert points[0]["serving_tip"] == srv["tpot_interference_pct"]
+    # kv_cache is null in long mode: requests_per_tick is the value
+    assert points[0]["value"] == srv["requests_per_tick"]
+    report = track(points, threshold_pct=5.0)
+    m = report["metrics"]["lm_longcontext_serving"]
+    assert m["ttft_long_best_prior"] is None      # abstains: no history
+    assert m["interference_best_prior"] is None
+    assert report["ok"]
+    worse = dict(head, serving=dict(
+        srv, ttft_long_p99=srv["ttft_long_p99"] * 1.5,
+        tpot_interference_pct=srv["tpot_interference_pct"] + 30.0))
+    wp = tmp_path / "worse.json"
+    wp.write_text(json.dumps(worse))
+    report = track(load_points([str(hp), str(wp)]), threshold_pct=5.0)
+    m = report["metrics"]["lm_longcontext_serving"]
+    assert m["ttft_long_regressed"] and m["interference_regressed"]
+    assert not report["ok"]
     assert not report["ok"]

@@ -244,6 +244,10 @@ def test_lm_learns_on_corpus():
     assert tr.best_ppl < 20.0
 
 
+@pytest.mark.slow  # tier-1 budget (PR 19): two full trainer builds (13s) for
+# the max_steps cap; max_steps-capped LMTrainer runs stay exercised
+# in-budget by test_lm_trainer_accepts_emitted_plan_file (max_steps=2) and
+# test_moe.py's MFU/router-mass runs (max_steps=2/3)
 def test_lm_max_steps_caps_run():
     cfg = LMConfig(max_steps=3, **TINY)
     tr = _run(cfg)

@@ -189,6 +189,10 @@ def test_top2_capacity_overflow_drops_second_choice():
     assert float(mass.max()) <= 1.0 + 1e-5
 
 
+@pytest.mark.slow  # tier-1 budget (PR 19): 13s compiled-FLOPs/memory property
+# on the 8-way mesh; EP stays exercised in-budget by
+# test_expert_parallel_matches_dp (same (data=1, expert=8) mesh, loss
+# parity vs dp) and test_moe_tp_composition_matches_dp
 def test_ep_actually_shards_expert_compute():
     """'EP is EP' (VERDICT r2 weak #5): on the SAME (data=1, expert=8) mesh
     with the SAME global batch, expert-sharding the params must cut the

@@ -106,6 +106,21 @@ def test_pl001_unknown_collective_axis_trips_and_control_is_clean():
     assert audit_jaxpr("p", good) == []
 
 
+def test_pl001_learns_sp_serving_axis():
+    """Satellite of PR 19: the 'sp' serving-sequence-parallel axis joined
+    parallel/mesh.py, and the reflection authority picked it up with zero
+    proglint changes — the sharded-pool gather's psum over 'sp' audits
+    clean while a typo'd spelling still trips."""
+    assert "sp" in mesh_axis_authority()
+    x = jnp.arange(8.0)
+    good = jax.make_jaxpr(_psum_program("sp"))(x)
+    assert audit_jaxpr("sp_gather", good) == []
+    bad = jax.make_jaxpr(_psum_program("spd"))(x)
+    fs = audit_jaxpr("sp_gather", bad)
+    assert [f.check for f in fs] == ["PL001"]
+    assert "'spd'" in fs[0].message
+
+
 def test_pl002_asymmetric_cond_psum_order_trips_proglint_and_dl201(
         tmp_path):
     """THE acceptance hazard: a cond whose arms issue psum/pmax in

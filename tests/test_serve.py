@@ -34,6 +34,7 @@ from tpu_dist.engine.kv_cache import PagedKVPool
 from tpu_dist.engine.serve import DecodeRequest, ServeConfig, ServeEngine
 from tpu_dist.models.transformer import tiny_lm
 from tpu_dist.obs.ledger import Ledger, read_ledger
+from tpu_dist.parallel.mesh import SP_AXIS, make_mesh
 
 V, L = 64, 32
 
@@ -564,3 +565,198 @@ def test_sigterm_routes_run_into_drain():
             if r["event"] == "run_end"] == ["preempted"]
     # the handler was restored by uninstall
     assert _signal.getsignal(_signal.SIGTERM) not in (None,)
+
+
+# ------------------- long-context serving plane (round 19)
+def _sp_mesh(n):
+    return make_mesh((n,), (SP_AXIS,), devices=jax.devices()[:n])
+
+
+def test_chunked_prefill_bit_identical_fp32():
+    """Chunked prefill (prefill_chunk=8) over MIXED prompt lengths emits
+    token-for-token the monolithic greedy stream: each chunk writes its
+    rows through the same per-row-position write mask the decode tick
+    uses and re-reads the earlier chunks' pages, so splitting the prompt
+    changes scheduling, never bits."""
+    lm, params = _lm_and_params(seed=22)
+    prompts = [((np.arange(13, dtype=np.int32) * 5 + 2) % V),
+               ((np.arange(18, dtype=np.int32) * 3 + 7) % V)]
+    refs = _greedy_refs(lm, params, prompts, [6, 6])
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=2, page_size=4, num_pages=32, prefill_chunk=8))
+    comps = eng.run([DecodeRequest(i, p, 6) for i, p in enumerate(prompts)])
+    assert len(comps) == 2
+    for c in comps:
+        np.testing.assert_array_equal(refs[c.rid], c.tokens)
+    # ceil(13/8) + ceil(18/8) chunk dispatches, one per iteration
+    assert eng.chunk_ticks == 2 + 3
+    assert eng.prefill_token_work == 5 * 8
+    assert eng.chunks_pending == 0
+    assert eng.pool.pages_free == eng.pool.num_pages
+
+
+def test_chunked_prefill_bit_identical_int8_wo():
+    """Quant twin of the chunked pin: int8 weight-only serving (the
+    deployment quant) chunks to the same tokens as its monolithic self.
+    (int8 KV pages are the documented exception — chunked re-READS
+    quantized rows monolithic prefill never quantizes.)"""
+    lm, params = _lm_and_params(seed=23)
+    prompt = ((np.arange(11, dtype=np.int32) * 7 + 1) % V).astype(np.int32)
+    ref = _greedy_refs(lm, params, [prompt], [5], quant="int8_wo")[0]
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=1, page_size=4, num_pages=16, prefill_chunk=4,
+        quant="int8_wo"))
+    comps = eng.run([DecodeRequest(0, prompt, 5)])
+    np.testing.assert_array_equal(ref, comps[0].tokens)
+    assert eng.chunk_ticks == 3
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """The scheduling contract itself: while a long prompt chunks in, the
+    already-decoding request keeps emitting one token per iteration — the
+    chunk rides the SAME scheduler step as the decode tick, it never
+    stalls the stream (the TPOT-interference bound decode_bench
+    measures). Deterministic: pure schedule math, no clocks."""
+    lm, params = _lm_and_params(seed=24)
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=2, page_size=4, num_pages=32, prefill_chunk=4))
+    assert eng.submit(DecodeRequest(0, np.array([1, 2, 3], np.int32), 12))
+    eng.step()                       # short admitted + first token
+    short = eng.slots[0]
+    assert short is not None and short.generated >= 1
+    long_prompt = ((np.arange(17, dtype=np.int32) * 5 + 3) % V)
+    assert eng.submit(DecodeRequest(1, long_prompt, 4))
+    gen_before, ticks_before = short.generated, eng.ticks
+    eng.step()                       # long admitted; chunk 1 + decode tick
+    assert eng.chunk_ticks == 1
+    assert eng.ticks == ticks_before + 1          # decode never skipped
+    assert short.generated == gen_before + 1
+    assert eng.chunks_pending == 4                # ceil(17/4) - 1 to go
+    eng.run()                                     # drain both
+    assert eng.completed == 2
+
+
+def test_sp_prefill_bit_identical_fp32():
+    """Sequence-parallel prefill over a 2-device sp mesh (ring attention
+    inside shard_map, each device scattering K/V into ITS local pages)
+    emits token-for-token the single-device stream — and a short prompt
+    below the threshold rides the monolithic program over the SAME
+    sharded pool (the flat block-table translation is exact either way)."""
+    lm, params = _lm_and_params(seed=25)
+    prompts = [((np.arange(12, dtype=np.int32) * 5 + 2) % V),
+               np.array([5, 9], np.int32)]
+    refs = _greedy_refs(lm, params, prompts, [6, 6])
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=2, page_size=8, num_pages=8, sp_prefill_threshold=9),
+        mesh=_sp_mesh(2))
+    comps = eng.run([DecodeRequest(i, p, 6) for i, p in enumerate(prompts)])
+    assert len(comps) == 2
+    for c in comps:
+        np.testing.assert_array_equal(refs[c.rid], c.tokens)
+    assert eng.sp_prefills == 1          # only the 12-token prompt
+    assert eng.pool.sharded_devices == 2
+    assert eng.pool.pages_free == eng.pool.num_pages
+
+
+def test_sp_prefill_bit_identical_int8_wo():
+    """Quant twin of the sp pin: int8 weight-only + sp-sharded prefill
+    still matches single-device int8_wo greedy bit-for-bit."""
+    lm, params = _lm_and_params(seed=26)
+    prompt = ((np.arange(14, dtype=np.int32) * 3 + 5) % V).astype(np.int32)
+    ref = _greedy_refs(lm, params, [prompt], [5], quant="int8_wo")[0]
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=1, page_size=8, num_pages=8, sp_prefill_threshold=9,
+        quant="int8_wo"), mesh=_sp_mesh(2))
+    comps = eng.run([DecodeRequest(0, prompt, 5)])
+    np.testing.assert_array_equal(ref, comps[0].tokens)
+    assert eng.sp_prefills == 1
+
+
+def test_sp_context_exceeds_single_device_page_budget():
+    """The capacity headline: a 4-device sp pool serves a context LONGER
+    than any one device's page budget (23 tokens vs 8 per device), with
+    tokens bitwise the unsharded stream — KV capacity scales with the
+    mesh, which is what the sharded pool exists for. Eviction then
+    returns every striped page to its owner's heap (second admit runs
+    on a fully reclaimed pool)."""
+    lm, params = _lm_and_params(seed=27)
+    prompt = ((np.arange(17, dtype=np.int32) * 5 + 1) % V).astype(np.int32)
+    ref = _greedy_refs(lm, params, [prompt], [6])[0]
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=1, page_size=4, num_pages=8, sp_prefill_threshold=9),
+        mesh=_sp_mesh(4))
+    budget = eng.pool.pages_per_device * eng.cfg.page_size
+    assert prompt.size + 6 > budget      # the context one device can't hold
+    for _ in range(2):                   # second wave = reclaim proof
+        comps = eng.run([DecodeRequest(0, prompt, 6)])
+        np.testing.assert_array_equal(ref, comps[0].tokens)
+        assert eng.pool.pages_free == eng.pool.num_pages
+    assert eng.sp_prefills == 2
+
+
+def test_sp_and_chunked_guards():
+    """Config guards: sp needs a mesh with the 'sp' axis and an sp-bucket-
+    divisible max_len; speculative decoding over a sharded pool is the
+    named residue and refuses loudly instead of corrupting pages."""
+    lm, params = _lm_and_params(seed=28)
+    with pytest.raises(ValueError, match="mesh"):
+        ServeEngine(lm, params, ServeConfig(sp_prefill_threshold=8))
+    with pytest.raises(ValueError, match="sp"):
+        ServeEngine(lm, params, ServeConfig(),
+                    mesh=make_mesh((2,), ("data",),
+                                   devices=jax.devices()[:2]))
+    with pytest.raises(ValueError, match="divisible"):
+        ServeEngine(lm, params, ServeConfig(
+            sp_prefill_threshold=8, page_size=4, max_len=28),
+            mesh=_sp_mesh(4))
+    with pytest.raises(NotImplementedError, match="speculative"):
+        ServeEngine(lm, params, ServeConfig(spec_k=2), mesh=_sp_mesh(2))
+
+
+def test_chunked_prefix_cache_compose_bit_identical():
+    """Chunked prefill + CoW prefix caching: a LATER identical prompt
+    maps onto the first one's pages — registered only at the FINAL chunk
+    (a partial prompt must never be shareable, so two concurrent chunked
+    admits of the same prompt correctly miss) — and both streams stay
+    bitwise the uncached monolithic greedy."""
+    lm, params = _lm_and_params(seed=29)
+    prompt = ((np.arange(13, dtype=np.int32) * 5 + 3) % V).astype(np.int32)
+    ref = _greedy_refs(lm, params, [prompt], [6])[0]
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=2, page_size=4, num_pages=32, prefill_chunk=4,
+        prefix_cache=True))
+    for _ in range(2):
+        comps = eng.run([DecodeRequest(0, prompt, 6)])
+        np.testing.assert_array_equal(ref, comps[0].tokens)
+    assert eng.pool.prefix_hits > 0      # second admit rode shared pages
+    assert eng.pool.cow_copies == 1
+    assert eng.chunk_ticks >= 4          # both admissions chunked
+
+
+def test_kv_cache_event_carries_serving_plane_fields(tmp_path):
+    """The ledger contract the report + DL006 fixtures lean on: every
+    kv_cache event now carries sharded_devices and chunks_pending (and
+    the cumulative chunk_ticks for the occupancy trend) — mid-chunking
+    snapshots show a nonzero backlog, the final one shows it drained."""
+    lm, params = _lm_and_params(seed=30)
+    path = tmp_path / "ledger.jsonl"
+    ledger = Ledger(str(path))
+    eng = ServeEngine(lm, params, ServeConfig(
+        max_slots=1, page_size=4, num_pages=32, prefill_chunk=4,
+        kv_event_every=1), ledger=ledger)
+    prompt = ((np.arange(17, dtype=np.int32) * 3 + 2) % V)
+    eng.submit(DecodeRequest(0, prompt, 4))
+    depths = []
+    while eng.queue or any(s is not None for s in eng.slots):
+        depths.append(eng.chunks_pending)
+        eng.step()
+    eng._emit_kv_cache()
+    ledger.close()
+    kv = [r for r in read_ledger(str(path)) if r["event"] == "kv_cache"]
+    assert kv, "no kv_cache events"
+    for r in kv:
+        assert r["sharded_devices"] == 1
+        assert "chunks_pending" in r and "chunk_ticks" in r
+    assert max(depths) > 0               # backlog was visible mid-flight
+    assert kv[-1]["chunks_pending"] == 0
+    assert kv[-1]["chunk_ticks"] == 5    # ceil(17/4)

@@ -59,8 +59,14 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
         if parsed is not None and "value" not in parsed \
                 and "kv_cache" in parsed:
             # decode_bench headline: the kv-cache tok/s IS the value (and
-            # round 11's serving replay block rides the same object)
-            parsed = dict(parsed, value=parsed["kv_cache"])
+            # round 11's serving replay block rides the same object). The
+            # long-context replay (round 19) skips the one-shot sections
+            # entirely (kv_cache: null) — its completed-requests-per-tick
+            # is the value, under its own metric name
+            v = parsed["kv_cache"]
+            if v is None and isinstance(parsed.get("serving"), dict):
+                v = parsed["serving"].get("requests_per_tick")
+            parsed = dict(parsed, value=v)
         if not parsed or "metric" not in parsed or "value" not in parsed:
             out_err(f"bench_track: skipping {path}: no parsed metric "
                     "(failed round or non-bench file)")
@@ -125,6 +131,12 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
             "serving_cov": (serving.get("tail_attribution") or {}).get(
                 "coverage") if isinstance(
                 serving.get("tail_attribution"), dict) else None,
+            # round 19+: the long-context replay's virtual-clock tail
+            # numbers, both LOWER is better — TTFT p99 of the >=threshold
+            # prompts, and short-request TPOT degradation vs the
+            # no-long-prompt baseline; pre-long-context history abstains
+            "serving_ttfl": serving.get("ttft_long_p99"),
+            "serving_tip": serving.get("tpot_interference_pct"),
             "fleet_goodput": fleet.get("goodput_ratio"),
             "round": rnd,
             "file": os.path.basename(path),
@@ -208,6 +220,26 @@ def track(points: List[dict], threshold_pct: float,
         cov_regressed = (cov_best is not None and cov_latest is not None
                          and (cov_best - cov_latest) / cov_best * 100.0
                          > threshold_pct)
+        # long-context TTFT p99 (round 19+): LOWER is better, virtual
+        # token-equivalent units — judged like pages_per_request against
+        # the best (lowest) prior carrying the field, fails on RISE
+        prior_ttfl = [p["serving_ttfl"] for p in prior
+                      if p.get("serving_ttfl") is not None]
+        ttfl_best = min(prior_ttfl, default=None)
+        ttfl_latest = latest.get("serving_ttfl")
+        ttfl_regressed = (ttfl_best is not None and ttfl_latest is not None
+                          and ttfl_best > 0
+                          and (ttfl_latest - ttfl_best) / ttfl_best * 100.0
+                          > threshold_pct)
+        # long-context TPOT interference (round 19+): LOWER is better and
+        # already a percentage — judged on ABSOLUTE percentage points
+        # (threshold_pct of them), since the best prior can sit near zero
+        prior_tip = [p["serving_tip"] for p in prior
+                     if p.get("serving_tip") is not None]
+        tip_best = min(prior_tip, default=None)
+        tip_latest = latest.get("serving_tip")
+        tip_regressed = (tip_best is not None and tip_latest is not None
+                         and tip_latest > tip_best + threshold_pct)
         # fleet goodput ratio (tpu_dist.sim): higher is better, judged
         # against the best prior point CARRYING a fleet block — pre-fleet
         # history abstains, exactly the data_s/serving convention
@@ -248,9 +280,16 @@ def track(points: List[dict], threshold_pct: float,
             "fleet_latest": fleet_latest,
             "fleet_best_prior": fleet_best,
             "fleet_regressed": fleet_regressed,
+            "ttft_long_latest": ttfl_latest,
+            "ttft_long_best_prior": ttfl_best,
+            "ttft_long_regressed": ttfl_regressed,
+            "interference_latest": tip_latest,
+            "interference_best_prior": tip_best,
+            "interference_regressed": tip_regressed,
         }
         if (regressed or data_regressed or srv_regressed or apt_regressed
-                or ppr_regressed or cov_regressed or fleet_regressed):
+                or ppr_regressed or cov_regressed or fleet_regressed
+                or ttfl_regressed or tip_regressed):
             report["ok"] = False
     return report
 
@@ -328,6 +367,32 @@ def render(report: dict, out=print) -> None:
                 out(f"  -> attribution: coverage "
                     f"{m['coverage_latest']:.4f} (no prior span history; "
                     "nothing to judge)")
+        if m.get("ttft_long_latest") is not None:
+            if m.get("ttft_long_best_prior") is not None:
+                verdict = ("TTFT-LONG REGRESSED"
+                           if m["ttft_long_regressed"] else "ok")
+                out(f"  -> ttft-long {verdict}: p99 "
+                    f"{m['ttft_long_latest']:,.1f} virtual tok-equiv vs "
+                    f"best (lowest) prior {m['ttft_long_best_prior']:,.1f} "
+                    f"(threshold {report['threshold_pct']:g}%, lower is "
+                    "better)")
+            else:
+                out(f"  -> ttft-long: p99 {m['ttft_long_latest']:,.1f} "
+                    "virtual tok-equiv (no prior long-context history; "
+                    "nothing to judge)")
+        if m.get("interference_latest") is not None:
+            if m.get("interference_best_prior") is not None:
+                verdict = ("INTERFERENCE REGRESSED"
+                           if m["interference_regressed"] else "ok")
+                out(f"  -> interference {verdict}: short-TPOT "
+                    f"{m['interference_latest']:+.2f}% vs best (lowest) "
+                    f"prior {m['interference_best_prior']:+.2f}% "
+                    f"(slack {report['threshold_pct']:g} percentage "
+                    "points, lower is better)")
+            else:
+                out(f"  -> interference: short-TPOT "
+                    f"{m['interference_latest']:+.2f}% (no prior "
+                    "long-context history; nothing to judge)")
         if m.get("fleet_latest") is not None:
             if m.get("fleet_best_prior") is not None:
                 verdict = ("FLEET REGRESSED" if m["fleet_regressed"]
@@ -395,7 +460,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                if m["regressed"] or m.get("data_s_regressed")
                or m.get("serving_regressed") or m.get("accepted_regressed")
                or m.get("pages_regressed") or m.get("coverage_regressed")
-               or m.get("fleet_regressed")]
+               or m.get("fleet_regressed") or m.get("ttft_long_regressed")
+               or m.get("interference_regressed")]
         print(f"bench_track: REGRESSION in {bad}", file=sys.stderr)
         return 1
     return 0

@@ -84,6 +84,229 @@ def _drive_trace(eng, arrivals, prompts, outs):
     return comps, _t.perf_counter() - t0
 
 
+class _VirtualClock:
+    """Deterministic engine clock for the long-context replay: one unit
+    is one TOKEN-EQUIVALENT of scheduler-step cost. Each iteration costs
+    ``tick_floor`` (the decode dispatch everyone pays) plus however many
+    prefill tokens that iteration actually pushed (the engine's
+    ``prefill_token_work`` delta) — so a monolithic 16k admit shows up as
+    one enormous inter-token gap for every concurrently-decoding request,
+    while chunked prefill amortizes the same work into
+    ``prefill_chunk``-sized bumps. The TPOT-interference number is then
+    pure cost-model arithmetic: machine-independent, warm-up-free, and
+    assertable in CI (the wall-clock twin would be noise on shared
+    runners)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _drive_longcontext(eng, clock, reqs, floor):
+    """Replay a long-context trace under the virtual cost-model clock:
+    arrivals are in scheduler-ITERATION units, and the clock advances by
+    floor + this iteration's prefill-token work after every step."""
+    from tpu_dist.engine.serve import DecodeRequest
+
+    n = len(reqs)
+    i = 0
+    it = 0
+    comps = []
+    while i < n or eng.queue or any(s is not None for s in eng.slots):
+        while i < n and reqs[i]["arrival"] <= it:
+            eng.submit(DecodeRequest(i, reqs[i]["prompt"],
+                                     int(reqs[i]["out_len"])))
+            i += 1
+        work0 = eng.prefill_token_work
+        comps.extend(eng.step())
+        clock.t += floor + (eng.prefill_token_work - work0)
+        it += 1
+        if it > 1_000_000:
+            raise RuntimeError("long-context replay did not drain")
+    return comps
+
+
+def replay_long_context(args, model, params, trace=None):
+    """--long-context / --prompt-len-dist: the mixed-traffic tail-latency
+    benchmark. A trace whose prompt lengths span orders of magnitude
+    (tools/traces/longcontext_mix.json ships a 16k admit among short
+    interactive requests) replays through chunked prefill under the
+    virtual cost-model clock, and the SAME trace with the long prompts
+    REMOVED replays as the interference baseline. The headline gains:
+
+    * ``ttft_long_p99``   — TTFT p99 of the long (>= long_threshold)
+      requests, in virtual token-equivalents: the price of admitting a
+      book-length prompt at all;
+    * ``tpot_interference_pct`` — how much the SHORT requests' TPOT p99
+      degrades when the long prompts are in flight, vs the no-long
+      baseline. Chunked prefill's whole claim is that this stays bounded
+      by chunk/tick_floor instead of exploding by prompt_len/tick_floor
+      (``--long-monolithic`` puts the unchunked contrast on record);
+    * ``sp_capacity``     — with ``--sp-capacity N``: a context longer
+      than ONE device's page budget served end-to-end on an N-device CPU
+      sp submesh (the sharded-pool existence proof, geometry-tiny).
+
+    ``tools/bench_track.py`` gates the first two like ``data_s``
+    (abstaining on pre-long-context history)."""
+    import numpy as np
+
+    from tpu_dist.engine.serve import (DecodeRequest, ServeConfig,
+                                       ServeEngine)
+
+    if trace is None and args.long_context:
+        with open(args.long_context) as f:
+            trace = json.load(f)
+    if trace is None:
+        # --prompt-len-dist "LEN:WEIGHT,LEN:WEIGHT,...": draw the trace's
+        # prompt lengths from the weighted mixture, everything else from
+        # the standard seeded Poisson machinery
+        pairs = [p.split(":") for p in args.prompt_len_dist.split(",")]
+        lens = np.array([int(l) for l, _ in pairs])
+        weights = np.array([float(w) for _, w in pairs], dtype=float)
+        weights = weights / weights.sum()
+        count = args.trace or 32
+        rng = np.random.default_rng(args.trace_seed)
+        gaps = rng.exponential(1.0 / max(args.arrival_rate, 1e-9), count)
+        arrivals = np.floor(np.cumsum(gaps)).astype(int)
+        plens = rng.choice(lens, size=count, p=weights)
+        outs = rng.integers(args.min_out, args.max_out + 1, count)
+        trace = {"seed": args.trace_seed, "tick_floor": args.tick_floor,
+                 "long_threshold": args.long_threshold,
+                 "requests": [
+                     {"arrival": int(a), "prompt_len": int(p),
+                      "out_len": int(o)}
+                     for a, p, o in zip(arrivals, plens, outs)]}
+    floor = trace["tick_floor"]
+    thr = trace["long_threshold"]
+    rng = np.random.default_rng(trace["seed"])
+    reqs = [dict(r) for r in trace["requests"]]
+    for r in reqs:
+        # token content drawn in trace order from the trace seed: the
+        # replay is bit-reproducible from the JSON alone
+        r["prompt"] = rng.integers(0, args.vocab_size,
+                                   (r["prompt_len"],)).astype(np.int32)
+    max_total = max(r["prompt_len"] + r["out_len"] for r in reqs)
+    pages_per_seq = -(-max_total // args.page_size)
+    num_pages = args.num_pages or args.serve_slots * pages_per_seq
+
+    def run(subset, chunk):
+        clock = _VirtualClock()
+        eng = ServeEngine(model, params, ServeConfig(
+            max_slots=args.serve_slots, page_size=args.page_size,
+            num_pages=num_pages, max_len=max_total,
+            quant=args.serve_quant, kv_quant=args.kv_quant,
+            prefill_chunk=chunk), now_fn=clock)
+        comps = _drive_longcontext(eng, clock, subset, floor)
+        return comps, eng
+
+    def _p99(xs):
+        from tools.ledger_report import _pctl
+
+        v = _pctl(sorted(xs), 99)
+        return None if v is None else round(v, 3)
+
+    def short_tpots(comps, subset):
+        return [(c.finish_ts - c.first_token_ts) / (c.n_generated - 1)
+                for c in comps if c.n_generated > 1
+                and subset[c.rid]["prompt_len"] < thr]
+
+    chunk = args.prefill_chunk
+    comps, eng = run(reqs, chunk)
+    ttft_long = [c.ttft_s for c in comps
+                 if reqs[c.rid]["prompt_len"] >= thr]
+    tpot_mixed = _p99(short_tpots(comps, reqs))
+    shorts_only = [r for r in reqs if r["prompt_len"] < thr]
+    base_comps, _ = run(shorts_only, chunk)
+    tpot_base = _p99(short_tpots(base_comps, shorts_only))
+    interference = (None if not tpot_base or tpot_mixed is None
+                    else round((tpot_mixed - tpot_base) / tpot_base * 100,
+                               2))
+    serving = {
+        "mode": "long_context",
+        "requests": len(reqs),
+        "long_requests": len(reqs) - len(shorts_only),
+        "completed": len(comps),
+        "ticks": eng.ticks, "chunk_ticks": eng.chunk_ticks,
+        "requests_per_tick": round(len(comps) / max(eng.ticks, 1), 4),
+        "prefill_token_work": eng.prefill_token_work,
+        "prefill_chunk": chunk, "tick_floor": floor,
+        "long_threshold": thr,
+        "trace_seed": trace["seed"],
+        "slots": args.serve_slots, "page_size": args.page_size,
+        "num_pages": num_pages, "kv_quant": args.kv_quant,
+        "occupancy": round(eng.occupancy, 4),
+        # virtual token-equivalent units throughout (see _VirtualClock)
+        "ttft_long_p99": _p99(ttft_long),
+        "tpot_short_p99": tpot_mixed,
+        "tpot_baseline_p99": tpot_base,
+        "tpot_interference_pct": interference,
+    }
+    print(f"serve[long-context]: {len(comps)}/{len(reqs)} completed "
+          f"({serving['long_requests']} long >= {thr} tok) in {eng.ticks} "
+          f"ticks + {eng.chunk_ticks} chunk ticks; TTFT-long p99 "
+          f"{serving['ttft_long_p99']}, short-TPOT interference "
+          f"{interference}% (chunk {chunk}, floor {floor})",
+          file=sys.stderr)
+    if getattr(args, "long_monolithic", False):
+        # the unchunked contrast: same trace, prefill_chunk=0 — the
+        # full-prompt stall lands in every concurrent short's TPOT
+        mono_comps, mono_eng = run(reqs, 0)
+        mono_p99 = _p99(short_tpots(mono_comps, reqs))
+        serving["monolithic"] = {
+            "tpot_short_p99": mono_p99,
+            "tpot_interference_pct": (
+                None if not tpot_base or mono_p99 is None
+                else round((mono_p99 - tpot_base) / tpot_base * 100, 2)),
+            "ticks": mono_eng.ticks,
+        }
+        print(f"serve[long-context]: monolithic contrast interference "
+              f"{serving['monolithic']['tpot_interference_pct']}%",
+              file=sys.stderr)
+    serving["sp_capacity"] = None
+    if args.sp_capacity > 0:
+        import jax
+
+        from tpu_dist.parallel.mesh import SP_AXIS, make_mesh
+
+        n = args.sp_capacity
+        if len(jax.devices()) < n:
+            print(f"serve[long-context]: sp capacity proof skipped "
+                  f"({len(jax.devices())} devices < {n}; set XLA_FLAGS="
+                  f"--xla_force_host_platform_device_count={n})",
+                  file=sys.stderr)
+        else:
+            # geometry-tiny existence proof: per-device budget 2 pages of
+            # 4 tokens, context > that budget, bit-served on the submesh
+            ps = 4
+            mesh = make_mesh((n,), (SP_AXIS,),
+                             devices=jax.devices()[:n])
+            eng_sp = ServeEngine(model, params, ServeConfig(
+                max_slots=1, page_size=ps, num_pages=2 * n,
+                max_len=8 * n, quant=args.serve_quant,
+                sp_prefill_threshold=ps + 1), mesh=mesh)
+            plen, out_len = 5 * n + 1, n + 2
+            sp_prompt = np.random.default_rng(trace["seed"]).integers(
+                0, args.vocab_size, (plen,)).astype(np.int32)
+            sp_comps = eng_sp.run([DecodeRequest(0, sp_prompt, out_len)])
+            budget = eng_sp.pool.pages_per_device * ps
+            serving["sp_capacity"] = {
+                "devices": n, "page_size": ps,
+                "pages_per_device": eng_sp.pool.pages_per_device,
+                "device_token_budget": budget,
+                "context_tokens": plen + out_len,
+                "exceeds_single_device": plen + out_len > budget,
+                "completed": len(sp_comps),
+                "sp_prefills": eng_sp.sp_prefills,
+            }
+            print(f"serve[long-context]: sp capacity — "
+                  f"{plen + out_len}-token context on {n} devices of "
+                  f"{budget}-token budget each "
+                  f"({len(sp_comps)} completed)", file=sys.stderr)
+    return serving
+
+
 def replay_serving_trace(args, model, params, ledger=None):
     """--trace: the throughput-under-load benchmark. One seeded trace
     (Poisson arrivals in tick units, mixed prompt/output lengths) replays
@@ -328,6 +551,43 @@ def main():
                     choices=["none", "int8", "int8_wo"],
                     help="weight quant for the serving engine "
                          "(engine.generate._quantize_for_decode)")
+    ap.add_argument("--long-context", default="",
+                    help="path to a long-context trace JSON (e.g. "
+                         "tools/traces/longcontext_mix.json): mixed "
+                         "short/long traffic replayed through chunked "
+                         "prefill under the virtual cost-model clock; "
+                         "adds serving.ttft_long_p99 and "
+                         "serving.tpot_interference_pct to the headline "
+                         "(replaces the one-shot decode sections)")
+    ap.add_argument("--prompt-len-dist", default="",
+                    help="generate the long-context trace instead of "
+                         "loading one: 'LEN:WEIGHT,LEN:WEIGHT,...' "
+                         "weighted prompt-length mixture (--trace N "
+                         "requests, --trace-seed, --arrival-rate, "
+                         "--min-out/--max-out as usual)")
+    ap.add_argument("--prefill-chunk", type=int, default=128,
+                    help="chunk size for the long-context replay "
+                         "(ServeConfig.prefill_chunk; 0 = monolithic)")
+    ap.add_argument("--long-threshold", type=int, default=1024,
+                    help="prompts at least this long count as 'long' for "
+                         "ttft_long_p99 / the interference baseline "
+                         "(--prompt-len-dist mode; trace files carry "
+                         "their own)")
+    ap.add_argument("--tick-floor", type=int, default=1024,
+                    help="virtual cost of one scheduler step before "
+                         "prefill work, in token-equivalents "
+                         "(--prompt-len-dist mode; trace files carry "
+                         "their own)")
+    ap.add_argument("--long-monolithic", action="store_true",
+                    help="also replay the long-context trace with "
+                         "prefill_chunk=0 and report the contrast "
+                         "interference (slow at 16k prompts: one "
+                         "prompt-sized forward)")
+    ap.add_argument("--sp-capacity", type=int, default=0,
+                    help="with the long-context replay: prove a context "
+                         "longer than one device's page budget serves on "
+                         "an N-device cpu sp submesh (geometry-tiny; "
+                         "needs XLA_FLAGS host_platform_device_count)")
     args = ap.parse_args()
 
     import jax
@@ -351,12 +611,27 @@ def main():
     from tpu_dist.engine.generate import generate
     from tpu_dist.models.transformer import TransformerLM
 
+    lc_trace = None
+    if args.long_context:
+        with open(args.long_context) as f:
+            lc_trace = json.load(f)
+    long_mode = lc_trace is not None or bool(args.prompt_len_dist)
+
     total = args.prompt_len + args.steps
     # the pos_emb table must cover the longest sequence either mode runs:
     # the one-shot geometry AND the trace replay's worst case
     max_len = max(total, (args.max_prompt + args.max_out
                           + (args.prefix_len if args.prefix_tenants else 0))
                   if args.trace else 0)
+    if long_mode:
+        if lc_trace is not None:
+            lc_max = max(r["prompt_len"] + r["out_len"]
+                         for r in lc_trace["requests"])
+        else:
+            lens = [int(p.split(":")[0])
+                    for p in args.prompt_len_dist.split(",")]
+            lc_max = max(lens) + args.max_out
+        max_len = max(max_len, lc_max, 8 * args.sp_capacity)
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
     if args.num_experts:
         from tpu_dist.models.moe import MoETransformerLM
@@ -434,13 +709,15 @@ def main():
                                     for d in jax.local_devices()}),
                     process_count=jax.process_count())
 
-    cache_rate, cache_ms, out_c = timed(True)
-    print(f"kv-cache decode: {cache_rate:,.0f} generated-tok/s incl. "
-          f"batched prefill ({cache_ms:.2f} ms/generated token, "
-          f"batch {args.batch}, {args.num_layers}L/d{args.d_model}, "
-          f"prompt {args.prompt_len}, total {total})", file=sys.stderr)
+    cache_rate = None
     full_rate = None
-    if not args.skip_full:
+    if not long_mode:
+        cache_rate, cache_ms, out_c = timed(True)
+        print(f"kv-cache decode: {cache_rate:,.0f} generated-tok/s incl. "
+              f"batched prefill ({cache_ms:.2f} ms/generated token, "
+              f"batch {args.batch}, {args.num_layers}L/d{args.d_model}, "
+              f"prompt {args.prompt_len}, total {total})", file=sys.stderr)
+    if not long_mode and not args.skip_full:
         full_rate, full_ms, out_f = timed(False)
         print(f"full-recompute decode: {full_rate:,.0f} tok/s "
               f"({full_ms:.2f} ms/token-tick)", file=sys.stderr)
@@ -460,7 +737,7 @@ def main():
     # nearest-rank percentiles match tools/ledger_report.decode_section
     latency = None
     req_tok_s = None
-    if args.requests > 0:
+    if not long_mode and args.requests > 0:
         lat = []
         for _ in range(args.requests):
             t0 = time.perf_counter()
@@ -481,7 +758,9 @@ def main():
               f"ms / p99 {latency['p99_ms']:.1f}ms", file=sys.stderr)
     # -- request-trace replay (continuous batching vs static, engine.serve)
     serving = None
-    if args.trace > 0:
+    if long_mode:
+        serving = replay_long_context(args, model, params, trace=lc_trace)
+    elif args.trace > 0:
         serving = replay_serving_trace(args, model, params, ledger=ledger)
 
     if ledger is not None:
@@ -490,8 +769,12 @@ def main():
         ledger.close()
 
     print(json.dumps({
-        "metric": "lm_decode_tokens_per_sec",
-        "kv_cache": round(cache_rate, 1),
+        # long-context replays publish their own metric name so the
+        # virtual-clock numbers never gate the wall-clock tok/s line
+        # (the same convention as quant/tp_impl variants in bench.py)
+        "metric": ("lm_longcontext_serving" if long_mode
+                   else "lm_decode_tokens_per_sec"),
+        "kv_cache": round(cache_rate, 1) if cache_rate is not None else None,
         "full_recompute": (round(full_rate, 1)
                            if full_rate is not None else None),
         "batch": args.batch, "prompt_len": args.prompt_len,

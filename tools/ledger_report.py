@@ -369,6 +369,20 @@ def decode_section(records, out=print):
             srv["prefix_hits_last"] = last.get("prefix_hits")
             srv["cow_copies_last"] = last.get("cow_copies")
             srv["shared_pages_last"] = last.get("shared_pages")
+            # round 19: the long-context serving plane — chunk-prefill
+            # occupancy (share of scheduler steps that ran a prefill
+            # chunk, from the cumulative chunk_ticks/tick counters) and
+            # the chunk-queue depth gauge (pending chunks across parked
+            # slots: max = worst backlog, last = drained or not)
+            co = _counter_trend(kv, "chunk_ticks", "tick")
+            # tick always advances, so the trend is 0.0 (not None) on a
+            # run that never chunked — treat that as absent
+            srv["chunk_occupancy"] = co if co and co["overall"] else None
+            depths = [r["chunks_pending"] for r in kv
+                      if r.get("chunks_pending") is not None]
+            srv["chunks_pending_max"] = max(depths) if depths else None
+            srv["chunks_pending_last"] = depths[-1] if depths else None
+            srv["sharded_devices"] = last.get("sharded_devices")
         d["serving"] = srv
         out(f"\nserving: {srv['completed']} completed, {rejected} rejected"
             + (f", occupancy {srv['occupancy'] * 100:.0f}%"
@@ -391,6 +405,18 @@ def decode_section(records, out=print):
                 f"{srv['cow_copies_last'] or 0} CoW forks, "
                 f"{srv['shared_pages_last'] or 0} pages shared at last "
                 "snapshot")
+        co = srv.get("chunk_occupancy")
+        if co is not None:
+            out("  chunked prefill: "
+                + f"{co['overall'] * 100:.0f}% of steps ran a chunk"
+                + (f" (first window {co['first'] * 100:.0f}% -> last "
+                   f"{co['last'] * 100:.0f}%)"
+                   if co.get("first") is not None else "")
+                + (f"; queue depth max {srv['chunks_pending_max']}, "
+                   f"last {srv['chunks_pending_last']}"
+                   if srv.get("chunks_pending_max") is not None else ""))
+        if (srv.get("sharded_devices") or 0) > 1:
+            out(f"  sp-sharded KV pool: {srv['sharded_devices']} devices")
     return d
 
 

@@ -43,13 +43,16 @@ from __future__ import annotations
 
 import hashlib
 import heapq
-from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from collections import Counter, OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpu_dist.ops.paged_attention import PagedLayer, pages_for
+from tpu_dist.parallel.mesh import SP_AXIS
 
 
 def _prefix_key(tokens) -> str:
@@ -110,7 +113,7 @@ class PagedKVPool:
 
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
                  num_heads: int, head_dim: int, dtype=jnp.float32,
-                 kv_quant: str = "none", read: str = "exact"):
+                 kv_quant: str = "none", read: str = "exact", mesh=None):
         if kv_quant not in ("none", "int8"):
             raise ValueError(f"kv_quant must be 'none' or 'int8', "
                              f"got {kv_quant!r}")
@@ -121,30 +124,68 @@ class PagedKVPool:
             raise ValueError("read='flash' is the int8-KV kernel path; "
                              "pass kv_quant='int8' (the fp exact path "
                              "needs no kernel)")
+        # sp sharding (long-context serving): the arenas' page dimension is
+        # laid out as `n` per-DEVICE blocks of `pages/n + 1` rows — every
+        # device carries its own pages plus its own LOCAL trash row, so the
+        # branch-free masked scatter survives sharding with zero cross-
+        # device traffic. Logical page ids stay 0..num_pages-1 host-side;
+        # device programs see FLAT rows via flat_block_table(). A 1-device
+        # (or absent) mesh degenerates to the classic num_pages+1 layout
+        # and an identity translation.
+        self.sp_mesh = mesh
+        n = 1
+        if mesh is not None:
+            if SP_AXIS not in mesh.shape:
+                raise ValueError(
+                    f"sharded pool needs a mesh with the {SP_AXIS!r} axis "
+                    f"(got axes {tuple(mesh.axis_names)})")
+            n = mesh.shape[SP_AXIS]
+            if num_pages % n:
+                raise ValueError(
+                    f"num_pages {num_pages} must divide by the {SP_AXIS!r} "
+                    f"axis size {n} (whole pages per device)")
+        self.sharded_devices = n
         self.num_layers = num_layers
         self.num_pages = num_pages
         self.page_size = page_size
         self.kv_quant = kv_quant
         self.read = read
-        shape = (num_pages + 1, page_size, num_heads, head_dim)
-        sshape = (num_pages + 1, page_size, num_heads)
+        self.pages_per_device = num_pages // n
+        self._rows_local = self.pages_per_device + 1   # + local trash row
+        rows = n * self._rows_local
+        shape = (rows, page_size, num_heads, head_dim)
+        sshape = (rows, page_size, num_heads)
+
+        def zeros(shp, dt):
+            z = jnp.zeros(shp, dt)
+            if mesh is not None:
+                z = jax.device_put(z, NamedSharding(mesh, P(SP_AXIS)))
+            return z
+
         self._layers: List[PagedLayer] = []
         for _ in range(num_layers):
             if kv_quant == "int8":
                 self._layers.append(PagedLayer(
-                    jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
-                    jnp.zeros(sshape, jnp.float32),
-                    jnp.zeros(sshape, jnp.float32),
+                    zeros(shape, jnp.int8), zeros(shape, jnp.int8),
+                    zeros(sshape, jnp.float32), zeros(sshape, jnp.float32),
                     quant="int8", read=read))
             else:
                 self._layers.append(PagedLayer(
-                    jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                    zeros(shape, dtype), zeros(shape, dtype),
                     quant="none", read=read))
-        # a min-heap of free page indices: O(log n) per free/grant instead
-        # of the round-11 full sort() per released request, with the SAME
-        # lowest-index-first grant order (determinism pin in test_serve)
-        self._free: List[int] = list(range(num_pages))
-        heapq.heapify(self._free)
+        # per-device min-heaps of free page indices: O(log n) per
+        # free/grant (round-18 discipline), grants lowest GLOBAL index
+        # first across the heaps — for an unsharded pool this is ONE heap
+        # and exactly the round-11 grant order (determinism pin in
+        # test_serve). The per-device split exists for the sp prefill's
+        # striped prompt allocation (alloc_for_slots), where each device
+        # scatters its own shard's K/V into pages it physically holds.
+        self._free_by_dev: List[List[int]] = [
+            list(range(d * self.pages_per_device,
+                       (d + 1) * self.pages_per_device))
+            for d in range(n)]
+        for h in self._free_by_dev:
+            heapq.heapify(h)
         self._ref: List[int] = [0] * num_pages
         # rc==0 pages still carrying indexed prefix content, FIFO by
         # release order (deterministic reclaim under pressure)
@@ -181,7 +222,8 @@ class PagedKVPool:
     @property
     def pages_free(self) -> int:
         """Allocatable pages: truly free + cached (reclaimable) ones."""
-        return len(self._free) + len(self._cached)
+        return (sum(len(h) for h in self._free_by_dev)
+                + len(self._cached))
 
     @property
     def pages_used(self) -> int:
@@ -195,25 +237,84 @@ class PagedKVPool:
     def pages_needed(self, total_tokens: int) -> int:
         return pages_for(total_tokens, self.page_size)
 
+    def page_device(self, page: int) -> int:
+        """The device block a logical page physically lives in (always 0
+        for an unsharded pool)."""
+        return page // self.pages_per_device
+
+    def _pop_free(self) -> Optional[int]:
+        """Pop the lowest GLOBAL free index across the per-device heaps
+        (O(devices) peek — devices is single digits)."""
+        best = None
+        for h in self._free_by_dev:
+            if h and (best is None or h[0] < best[0]):
+                best = h
+        return heapq.heappop(best) if best is not None else None
+
     def alloc(self, n: int) -> Optional[List[int]]:
         """Grant ``n`` fresh pages at refcount 1 (all-or-nothing; None
         when short). Free pages go first, lowest index first; cached
         prefix pages are reclaimed FIFO (and unregistered) only when the
-        free heap runs dry — pool pressure evicts the cache, never the
+        free heaps run dry — pool pressure evicts the cache, never the
         other way around."""
         if n > self.pages_free:
             return None
-        grant = [heapq.heappop(self._free)
-                 for _ in range(min(n, len(self._free)))]
+        grant: List[int] = []
         while len(grant) < n:
-            page, _ = self._cached.popitem(last=False)
-            self._unregister(page)
+            page = self._pop_free()
+            if page is None:
+                page, _ = self._cached.popitem(last=False)
+                self._unregister(page)
             grant.append(page)
         for p in grant:
             self._ref[p] = 1
         self.alloc_total += n
         self.high_water_used = max(self.high_water_used, self.pages_used)
         return grant
+
+    def alloc_for_slots(self, devs: Sequence[int]) -> Optional[List[int]]:
+        """Grant one page per requested DEVICE, in slot order (all-or-
+        nothing; None when any device is short). The sp prefill's striped
+        prompt allocation: block-table slot ``t`` of a sequence prefilled
+        over ``n`` sequence shards must live on the device whose shard
+        writes its rows (``(t * page_size) // shard_len``) — reads never
+        care (the gather psum is location-free), so only the prompt slots
+        an sp prefill will scatter into come through here. Per-device
+        grants are lowest-index-first; cached pages on the right device
+        reclaim FIFO, same policy as :meth:`alloc`."""
+        need = Counter(devs)
+        for d, c in need.items():
+            avail = len(self._free_by_dev[d]) + sum(
+                1 for p in self._cached if self.page_device(p) == d)
+            if avail < c:
+                return None
+        grant: List[int] = []
+        for d in devs:
+            if self._free_by_dev[d]:
+                p = heapq.heappop(self._free_by_dev[d])
+            else:
+                p = next(q for q in self._cached
+                         if self.page_device(q) == d)
+                del self._cached[p]
+                self._unregister(p)
+            self._ref[p] = 1
+            grant.append(p)
+        self.alloc_total += len(grant)
+        self.high_water_used = max(self.high_water_used, self.pages_used)
+        return grant
+
+    def flat_block_table(self, bt: np.ndarray) -> np.ndarray:
+        """Logical page ids -> FLAT arena rows (the device programs' view):
+        page ``p`` sits at ``p + p // pages_per_device`` (its device block
+        offset by one trash row per preceding device), and the unassigned
+        sentinel (``num_pages``) maps to the LAST arena row — a trash row,
+        so masked writes and padded gathers keep landing on garbage that
+        no live sequence owns. Identity for an unsharded pool."""
+        bt = np.asarray(bt)
+        return np.where(
+            bt >= self.num_pages,
+            self.sharded_devices * self._rows_local - 1,
+            bt + bt // self.pages_per_device).astype(np.int32)
 
     def free(self, pages: List[int]) -> None:
         """Drop one reference per listed page. A page parks in the cached
@@ -228,7 +329,8 @@ class PagedKVPool:
                 if p in self._reg:
                     self._cached[p] = None
                 else:
-                    heapq.heappush(self._free, p)
+                    heapq.heappush(self._free_by_dev[self.page_device(p)],
+                                   p)
 
     def contiguous_pages_needed(self, slots: int, max_total: int) -> int:
         """What a contiguous per-slot allocator would preallocate for the
@@ -351,8 +453,11 @@ class PagedKVPool:
         from tpu_dist.ops.paged_attention import cow_fork_pages
 
         t0 = self._now() if self._now is not None else 0.0
-        src_a = jnp.asarray([src], jnp.int32)
-        dst_a = jnp.asarray([dst], jnp.int32)
+        # arenas index by FLAT rows (sharded pools interleave trash rows);
+        # identity when unsharded
+        flat = self.flat_block_table(np.asarray([src, dst], np.int32))
+        src_a = jnp.asarray(flat[:1])
+        dst_a = jnp.asarray(flat[1:])
         self._layers = list(cow_fork_pages(tuple(self._layers),
                                            src_a, dst_a))
         self.free([src])
@@ -381,6 +486,8 @@ class PagedKVPool:
                 "pages_total": self.num_pages,
                 "pages_cached": len(self._cached),
                 "page_size": self.page_size,
+                "sharded_devices": self.sharded_devices,
+                "pages_per_device": self.pages_per_device,
                 "high_water_used": self.high_water_used,
                 "shared_pages": self.shared_pages,
                 "prefix_hits": self.prefix_hits,

@@ -77,11 +77,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist._compat import shard_map
 from tpu_dist.engine.generate import (_quantize_for_decode, _refuse_wo_tree,
                                       _sample, prepare_draft)
 from tpu_dist.engine.kv_cache import PagedKVPool, PrefixMatch
 from tpu_dist.obs.reqtrace import RequestTracer
 from tpu_dist.ops.paged_attention import cow_fork_pages
+from tpu_dist.parallel.mesh import SP_AXIS
+from tpu_dist.parallel.ring_attention import ring_attention_fn
 from tpu_dist.plan.compile import check_audit_sentry, register_audit_program
 
 
@@ -149,6 +154,16 @@ class ServeConfig:
     kv_event_every: int = 0      # ticks between kv_cache events (0 = final)
     spec_k: int = 0              # draft tokens per tick (0 = plain decode)
     prefix_cache: bool = False   # CoW prefix sharing across requests
+    # chunked prefill (long-context tail stability): prompts longer than
+    # this run as fixed-size chunks, at most ONE chunk interleaved per
+    # scheduler iteration with the decode tick — a 16k admit costs many
+    # bounded steps instead of one full-prompt stall. 0 = monolithic.
+    prefill_chunk: int = 0
+    # sequence-parallel prefill (needs ServeEngine(mesh=...)): prompts at
+    # or past this threshold prefill under ring attention over the 'sp'
+    # axis, each device scattering its shard's K/V into its LOCAL pages —
+    # no full-sequence K/V on any one device. 0 = never.
+    sp_prefill_threshold: int = 0
     # request tracing: decode spans coalesce this many ticks per slot into
     # one window span (per-token spans would dwarf the ledger; windows
     # keep the waterfall readable AND tile first-token->finish exactly)
@@ -173,6 +188,13 @@ class _Slot:
     # page this sequence will write into — forked right before its first
     # decode write (engine._resolve_cow), None once private
     cow_pending: Optional[Tuple[int, int, int]] = None
+    # chunked prefill state: the next prompt offset to prefill (-1 once
+    # the prompt is fully resident and the first token sampled — only
+    # then does the slot join the decode tick's active set)
+    chunk_next: int = -1
+    shared_len: int = 0          # prefix-cache-resident prompt rows
+    n_fresh: int = 0             # admission page accounting (span fields)
+    n_shared: int = 0
     # request tracing: the open decode-window span (obs.reqtrace) — opens
     # at the first token, closes every trace_window_ticks ticks and at
     # finish, so the windows tile first-token->finish contiguously
@@ -200,7 +222,7 @@ def _default_buckets(max_len: int) -> Tuple[int, ...]:
 # rationale as engine.generate's program caches.
 
 @lru_cache(maxsize=32)
-def _prefill_program(model, temperature, top_k, top_p):
+def _prefill_program(model, temperature, top_k, top_p, sp_mesh=None):
     # the arenas are DONATED: the caller (the pool) adopts the returned
     # ones and never touches the old buffers again, and without aliasing
     # every call would copy every layer's whole page arena — per admitted
@@ -222,7 +244,7 @@ def _prefill_program(model, temperature, top_k, top_p):
         paged = {"layers": layers, "block_tables": block_table,
                  "positions": jnp.zeros((1,), jnp.int32),
                  "lengths": jnp.asarray(length, jnp.int32)[None],
-                 "valid": valid}
+                 "valid": valid, "sp_mesh": sp_mesh}
         logits, new_layers = model.apply(
             {"params": params}, prompt, train=False,
             paged=paged, paged_prefill=True)
@@ -236,7 +258,7 @@ def _prefill_program(model, temperature, top_k, top_p):
 
 
 @lru_cache(maxsize=32)
-def _tick_program(model, temperature, top_k, top_p):
+def _tick_program(model, temperature, top_k, top_p, sp_mesh=None):
     # arenas donated for the same reason as _prefill_program: the tick
     # writes one row per slot and the un-aliased alternative is a full
     # arena copy per generated token
@@ -247,7 +269,8 @@ def _tick_program(model, temperature, top_k, top_p):
         # the trash page and their (ignored) logits cost one lane of the
         # same program — occupancy changes never retrace
         paged = {"layers": layers, "block_tables": block_tables,
-                 "positions": positions, "lengths": positions + 1}
+                 "positions": positions, "lengths": positions + 1,
+                 "sp_mesh": sp_mesh}
         logits, new_layers = model.apply(
             {"params": params}, tokens[:, None], train=False,
             pos_offset=positions, paged=paged)
@@ -255,6 +278,113 @@ def _tick_program(model, temperature, top_k, top_p):
         return nxt.astype(jnp.int32), new_layers, rng
 
     return tick
+
+
+@lru_cache(maxsize=32)
+def _chunk_prefill_program(model, chunk, sp_mesh=None):
+    # One prefill CHUNK: rows [start, start+chunk) of a prompt, written
+    # and attended through the SAME per-row-position machinery the decode
+    # tick uses (ops.paged_attention, prefill=False) — the chunk's queries
+    # read the gathered pages, which at that point hold exactly the
+    # earlier chunks' rows plus this chunk's own (causally masked), so
+    # chunked greedy is token-for-token the monolithic prefill
+    # (tests/test_serve.py pins it; int8 KV pages are the one exception —
+    # earlier chunks re-read quantized rows monolithic never quantizes).
+    # Returns the last LIVE row's logits (meaningful on the final chunk
+    # only) + updated arenas; sampling stays host-sequenced in
+    # _sample_first_program so the rng stream advances exactly once per
+    # admit, same as monolithic.
+    @partial(jax.jit, donate_argnums=(1,))
+    def chunk_step(params, layers, block_table, start, length, shared_len,
+                   tokens):
+        pos = jnp.asarray(start, jnp.int32)[None]               # (1,)
+        rows = pos[:, None] + jnp.arange(chunk, dtype=jnp.int32)[None]
+        valid = ((rows < jnp.asarray(length, jnp.int32))
+                 & (rows >= jnp.asarray(shared_len, jnp.int32)))
+        paged = {"layers": layers, "block_tables": block_table,
+                 "positions": pos, "lengths": pos + chunk,
+                 "valid": valid, "sp_mesh": sp_mesh}
+        logits, new_layers = model.apply(
+            {"params": params}, tokens, train=False,
+            pos_offset=pos, paged=paged)
+        last = jnp.take_along_axis(
+            logits,
+            jnp.reshape(jnp.clip(length - 1 - start, 0, chunk - 1),
+                        (1, 1, 1)).astype(jnp.int32), axis=1)[:, 0]
+        return last, new_layers
+
+    return chunk_step
+
+
+@lru_cache(maxsize=32)
+def _sample_first_program(temperature, top_k, top_p):
+    # the final chunk's first-token sample: the same _sample call (and the
+    # same single rng consumption) _prefill_program fuses in-program
+    @jax.jit
+    def sample_first(last, rng):
+        nxt, rng = _sample(last, temperature, rng, top_k, top_p)
+        return nxt[0].astype(jnp.int32), rng
+
+    return sample_first
+
+
+@lru_cache(maxsize=32)
+def _sp_prefill_program(model, mesh, temperature, top_k, top_p):
+    # Sequence-parallel prefill: the padded prompt splits into n
+    # contiguous shards over the 'sp' axis inside shard_map; each device
+    # runs the model on ITS shard with ring attention as the attention
+    # contraction (parallel.ring_attention — K/V rotate, exact causal
+    # attention, O(bucket/n) sequence memory per device) and scatters its
+    # shard's K/V rows straight into the pages it physically owns (the
+    # sp-sharded pool's striped prompt allocation guarantees ownership).
+    # The full-sequence K/V never materializes on any one device — the
+    # whole point. The last live row's logits live on one shard; a
+    # masked psum replicates them for the (replicated) first-token sample.
+    n = mesh.shape[SP_AXIS]
+    sp_model = model.clone(attn_fn=ring_attention_fn(SP_AXIS))
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def prefill(params, layers, block_table, length, shared_len, prompt,
+                rng):
+        lsh = prompt.shape[1] // n         # bucket % (n * page_size) == 0
+
+        def shard_fn(params, layers, bt, length, shared_len, prompt):
+            rows_local = layers[0].k.shape[0]
+            me = jax.lax.axis_index(SP_AXIS)
+            pos = jnp.asarray(me * lsh, jnp.int32)[None]        # (1,)
+            rows = pos[:, None] + jnp.arange(lsh, dtype=jnp.int32)[None]
+            valid = rows >= jnp.asarray(shared_len, jnp.int32)
+            # FLAT global rows -> my local rows; foreign pages route to my
+            # LOCAL trash row (their owner writes the real bits)
+            local_bt = jnp.where(bt // rows_local == me,
+                                 bt % rows_local, rows_local - 1)
+            paged = {"layers": layers, "block_tables": local_bt,
+                     "positions": pos,
+                     "lengths": jnp.asarray(length, jnp.int32)[None],
+                     "valid": valid}
+            logits, new_layers = sp_model.apply(
+                {"params": params}, prompt, train=False, pos_offset=pos,
+                paged=paged, paged_prefill=True)
+            idx = jnp.clip(length - 1 - pos[0], 0, lsh - 1)
+            last = jnp.take_along_axis(
+                logits, jnp.reshape(idx, (1, 1, 1)).astype(jnp.int32),
+                axis=1)[:, 0]
+            owns_last = (length - 1 >= pos[0]) & (length - 1 < pos[0] + lsh)
+            last = jax.lax.psum(
+                jnp.where(owns_last, last, jnp.zeros_like(last)), SP_AXIS)
+            return last, new_layers
+
+        last, new_layers = shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(), P(SP_AXIS), P(), P(), P(), P(None, SP_AXIS)),
+            out_specs=(P(), P(SP_AXIS)))(
+            params, layers, block_table,
+            jnp.asarray(length, jnp.int32),
+            jnp.asarray(shared_len, jnp.int32), prompt)
+        nxt, rng = _sample(last, temperature, rng, top_k, top_p)
+        return nxt[0].astype(jnp.int32), new_layers, rng
+
+    return prefill
 
 
 @lru_cache(maxsize=32)
@@ -365,7 +495,7 @@ class ServeEngine:
                  *, draft_model=None, draft_params=None, ledger=None,
                  tracer: Optional[RequestTracer] = None,
                  now_fn: Callable[[], float] = time.monotonic,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None, mesh=None):
         config = config if config is not None else ServeConfig()
         if getattr(model, "num_experts", 0):
             raise NotImplementedError(
@@ -381,11 +511,27 @@ class ServeEngine:
         self.params = params
         self.cfg = cfg
         self.max_len = min(cfg.max_len or model.max_len, model.max_len)
+        # sp-sharded serving (mesh= with the 'sp' axis): the pool's arenas
+        # shard over the axis, so effective KV capacity scales with the
+        # mesh and contexts larger than ONE device's page budget serve
+        self.sp_mesh = mesh
+        self.sp_n = 1
+        if mesh is not None:
+            if SP_AXIS not in mesh.shape:
+                raise ValueError(
+                    f"ServeEngine mesh needs the {SP_AXIS!r} axis (got "
+                    f"axes {tuple(mesh.axis_names)})")
+            self.sp_n = mesh.shape[SP_AXIS]
+            if cfg.spec_k > 0:
+                raise NotImplementedError(
+                    "speculative decoding over an sp-sharded pool is the "
+                    "named residue: the draft scan's per-step sharded "
+                    "writes need their own collective story")
         head_dim = model.d_model // model.num_heads
         self.pool = PagedKVPool(
             model.num_layers, cfg.num_pages, cfg.page_size,
             model.num_heads, head_dim, dtype=model.dtype,
-            kv_quant=cfg.kv_quant, read=cfg.attn_read)
+            kv_quant=cfg.kv_quant, read=cfg.attn_read, mesh=mesh)
         self.max_pages_per_seq = self.pool.pages_needed(self.max_len)
         # speculative decoding: a draft proposes cfg.spec_k tokens per tick
         # over its OWN arenas (a second pool, same page geometry + indices,
@@ -427,6 +573,23 @@ class ServeEngine:
         self.buckets = tuple(sorted({self.max_len, *(
             b for b in (cfg.prefill_buckets or _default_buckets(self.max_len))
             if b <= self.max_len)}))
+        # sp prefill needs buckets whose shards hold WHOLE pages: the
+        # striped prompt allocation places block-table slot t on device
+        # (t*page_size)//shard_len, which is only well-defined when
+        # shard_len % page_size == 0
+        self.sp_buckets: Tuple[int, ...] = ()
+        if cfg.sp_prefill_threshold > 0:
+            if mesh is None:
+                raise ValueError("sp_prefill_threshold > 0 needs "
+                                 "ServeEngine(mesh=...) with the "
+                                 f"{SP_AXIS!r} axis")
+            step = self.sp_n * cfg.page_size
+            if self.max_len % step:
+                raise ValueError(
+                    f"sp prefill needs max_len ({self.max_len}) divisible "
+                    f"by sp devices x page_size ({self.sp_n} x "
+                    f"{cfg.page_size}) so every prompt has an sp bucket")
+            self.sp_buckets = tuple(b for b in self.buckets if b % step == 0)
         self.slots: List[Optional[_Slot]] = [None] * cfg.max_slots
         self.queue: Deque[Tuple[DecodeRequest, float]] = deque()
         self._now = now_fn
@@ -447,6 +610,13 @@ class ServeEngine:
         self.completed = 0
         self.rejected = 0
         self.prefills = 0
+        self.sp_prefills = 0
+        # chunked-prefill accounting: chunk dispatches interleaved with
+        # the decode stream, plus the cumulative prefill TOKEN work — the
+        # per-step delta is the virtual cost-model clock's prefill term
+        # (tools/decode_bench.py --long-context)
+        self.chunk_ticks = 0
+        self.prefill_token_work = 0
         # speculative accounting: emitted tokens vs active-slot tick
         # opportunities — accepted_per_tick = spec_emitted/spec_slot_ticks
         # (identically 1.0 for plain decode; > 1.0 is speculation's win)
@@ -571,6 +741,7 @@ class ServeEngine:
         completions evicted this iteration."""
         completions = self._evict()
         self._admit()
+        self._chunk_tick()
         self._tick()
         self._decay_wait_if_idle()
         every = self.cfg.kv_event_every
@@ -723,8 +894,15 @@ class ServeEngine:
                 continue
             req, enq_ts = self.queue[0]
             prompt = np.asarray(req.prompt, np.int32).reshape(-1)
-            total = prompt.size + req.max_new_tokens
+            p = prompt.size
+            total = p + req.max_new_tokens
             total_slots = self.pool.pages_needed(total)
+            use_sp = (self.cfg.sp_prefill_threshold > 0
+                      and p >= self.cfg.sp_prefill_threshold)
+            use_chunk = (not use_sp and self.cfg.prefill_chunk > 0
+                         and p > self.cfg.prefill_chunk)
+            sp_bucket = (next(b for b in self.sp_buckets if b >= p)
+                         if use_sp else None)
             match = (self.pool.share_prefix(prompt, rid=req.rid)
                      if self.cfg.prefix_cache else None)
             # fresh pages: everything past the FULL-page hits. A frontier
@@ -732,8 +910,28 @@ class ServeEngine:
             # reserves one fresh page as its copy-on-write destination —
             # reserving at admission means the later fork can never fail,
             # so the net fresh cost is total_slots - full either way.
-            fresh = self.pool.alloc(
-                total_slots - (match.full if match is not None else 0))
+            n_fresh = total_slots - (match.full if match is not None else 0)
+            if use_sp:
+                # striped prompt pages: slot t's rows are scattered by the
+                # device whose prompt shard covers them, so the page must
+                # physically live there. Shared slots sit wherever their
+                # writer put them (reads are location-free); decode-tail
+                # pages (and the CoW reserve) are unconstrained.
+                shard = sp_bucket // self.sp_n
+                shared_slots = len(match.pages) if match is not None else 0
+                stripe = [(t * self.cfg.page_size) // shard
+                          for t in range(shared_slots,
+                                         self.pool.pages_needed(p))]
+                fresh = self.pool.alloc_for_slots(stripe)
+                if fresh is not None:
+                    rest = self.pool.alloc(n_fresh - len(stripe))
+                    if rest is None:
+                        self.pool.free(fresh)
+                        fresh = None
+                    else:
+                        fresh = fresh + rest
+            else:
+                fresh = self.pool.alloc(n_fresh)
             if fresh is None:
                 if match is not None:
                     self.pool.unshare(match)
@@ -753,7 +951,14 @@ class ServeEngine:
                                start=round(enq_ts, 6), end=round(now, 6),
                                queue_depth=len(self.queue),
                                tenant=req.tenant, **tr.attrs())
-            self._prefill(i, req, prompt, fresh, enq_ts, now, match)
+            if use_sp:
+                self._prefill_sp(i, req, prompt, fresh, enq_ts, now, match,
+                                 sp_bucket)
+            elif use_chunk:
+                self._begin_chunked(i, req, prompt, fresh, enq_ts, now,
+                                    match)
+            else:
+                self._prefill(i, req, prompt, fresh, enq_ts, now, match)
 
     def _prefill(self, slot_idx, req, prompt, fresh, enq_ts, start_ts,
                  match: Optional[PrefixMatch] = None):
@@ -777,17 +982,20 @@ class ServeEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :p] = prompt
         program = _prefill_program(self.model, self.cfg.temperature,
-                                   self.cfg.top_k, self.cfg.top_p)
+                                   self.cfg.top_k, self.cfg.top_p,
+                                   self.sp_mesh)
         # recompile sentry (analysis.proglint PL005): prefill specializes
         # per bucket BY DESIGN, so its allowed trace-cache size is the
         # bucket-ladder length, not 1 (no-op when the audit is off)
         register_audit_program("serve_prefill", program,
                                allowed=len(self.buckets))
         tok, new_layers, self._rng = program(
-            self.params, self.pool.layers(), jnp.asarray(bt[None]),
+            self.params, self.pool.layers(),
+            jnp.asarray(self.pool.flat_block_table(bt[None])),
             jnp.int32(p), jnp.int32(shared_len), jnp.asarray(padded),
             self._rng)
         self.pool.adopt(new_layers)
+        self.prefill_token_work += bucket
         if self.draft_pool is not None:
             # the draft's prompt rows, through the same block table (the
             # pools share page indices); shared rows were written by the
@@ -837,6 +1045,203 @@ class ServeEngine:
                            shared_len=shared_len, cow=cow is not None,
                            tenant=req.tenant, **tr.attrs())
 
+    # -- chunked prefill ---------------------------------------------------
+    def _begin_chunked(self, slot_idx, req, prompt, fresh, enq_ts, start_ts,
+                       match: Optional[PrefixMatch] = None):
+        """Admit a long prompt WITHOUT running its prefill: the slot parks
+        with ``chunk_next >= 0`` (outside the decode tick's active set) and
+        :meth:`_chunk_tick` feeds it one fixed-size chunk per scheduler
+        iteration — a 16k admit costs many bounded steps interleaved with
+        the decode stream instead of one full-prompt stall. First token,
+        prefix registration, and the prefill span all land on the FINAL
+        chunk (the pages only hold the whole prompt then)."""
+        p = prompt.size
+        chunk = self.cfg.prefill_chunk
+        shared = list(match.pages) if match is not None else []
+        shared_len = match.cov if match is not None else 0
+        cow = None
+        if match is not None and match.partial:
+            cow = (match.full, shared[-1], fresh[-1])
+            bt_pages = shared + fresh[:-1]
+        else:
+            bt_pages = shared + fresh
+        bt = np.full((self.max_pages_per_seq,), self.pool.num_pages,
+                     np.int32)
+        bt[:len(bt_pages)] = bt_pages
+        slot = _Slot(req=req, pages=shared + fresh, block_table=bt,
+                     buf=np.zeros((p + req.max_new_tokens,), np.int32),
+                     prompt_len=p, admit_ts=enq_ts, start_ts=start_ts,
+                     position=p, generated=0, cow_pending=cow,
+                     # start at the chunk holding the first NON-shared row
+                     # (a fully-shared prompt still runs its last chunk:
+                     # writes are masked, but the final chunk's logits are
+                     # where the first token comes from)
+                     chunk_next=min(shared_len, p - 1) // chunk * chunk,
+                     shared_len=shared_len,
+                     n_fresh=len(fresh), n_shared=len(shared))
+        slot.buf[:p] = prompt
+        self.slots[slot_idx] = slot
+
+    def _chunk_tick(self) -> None:
+        """At most ONE prefill chunk per scheduler iteration — the knob
+        that bounds how much prefill compute any decode tick waits behind
+        (the TPOT-interference contract tools/decode_bench.py measures).
+        Lowest slot index first: admission order, no starvation."""
+        for i, s in enumerate(self.slots):
+            if s is not None and not s.done and s.chunk_next >= 0:
+                self._run_chunk(i, s)
+                return
+
+    def _run_chunk(self, slot_idx: int, s: _Slot) -> None:
+        cfg = self.cfg
+        chunk = cfg.prefill_chunk
+        p = s.prompt_len
+        start = s.chunk_next
+        tokens = np.zeros((1, chunk), np.int32)
+        seg = s.buf[start:min(start + chunk, p)]
+        tokens[0, :seg.size] = seg
+        program = _chunk_prefill_program(self.model, chunk, self.sp_mesh)
+        # one chunk geometry per deployment: any retrace is a bug
+        register_audit_program("serve_chunk_prefill", program)
+        last, new_layers = program(
+            self.params, self.pool.layers(),
+            jnp.asarray(self.pool.flat_block_table(s.block_table[None])),
+            jnp.int32(start), jnp.int32(p), jnp.int32(s.shared_len),
+            jnp.asarray(tokens))
+        self.pool.adopt(new_layers)
+        self.chunk_ticks += 1
+        self.prefill_token_work += chunk
+        if start + chunk < p:
+            s.chunk_next = start + chunk
+            return
+        # final chunk: the prompt is fully resident — sample the first
+        # token (ONE rng consumption per admit, same as monolithic),
+        # index the pages for future sharers, open the decode life
+        s.chunk_next = -1
+        sampler = _sample_first_program(cfg.temperature, cfg.top_k,
+                                        cfg.top_p)
+        register_audit_program("serve_chunk_sample", sampler)
+        tok, self._rng = sampler(last, self._rng)
+        if self.draft_pool is not None:
+            # the draft arenas are tiny: its prompt pass stays monolithic
+            # (and on the LOGICAL block table — the draft pool is never
+            # sharded), keeping the chunked path draft-compatible
+            bucket = next(b for b in self.buckets if b >= p)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :p] = s.buf[:p]
+            dprog = _draft_prefill_program(self.draft_model)
+            self.draft_pool.adopt(dprog(
+                self.draft_params, self.draft_pool.layers(),
+                jnp.asarray(s.block_table[None]), jnp.int32(p),
+                jnp.int32(s.shared_len), jnp.asarray(padded)))
+        if cfg.prefix_cache:
+            # register only NOW: until the final chunk the pages hold a
+            # partial prompt and a hit against them would read garbage
+            bt_pages = [int(x) for x in s.block_table
+                        if int(x) < self.pool.num_pages]
+            self.pool.register_prefix(s.buf[:p], bt_pages,
+                                      skip_slots=s.n_shared)
+            self.prompt_pages += self.pool.pages_needed(p)
+            self.shared_prompt_pages += s.n_shared
+        self.prefills += 1
+        # distlint: disable=DL002 -- iteration-level scheduling syncs once per admit by design
+        tok = int(jax.device_get(tok))
+        now = self._now()
+        s.buf[p] = tok
+        s.generated = 1
+        s.first_token_ts = now
+        s.win_start_ts = now
+        if s.generated >= s.req.max_new_tokens or tok == cfg.eos_id:
+            s.done = True
+            s.finish_ts = now
+        if self.tracer is not None:
+            tr = self.tracer
+            tid, sid, par = tr.ids(s.req.rid, "prefill")
+            first = min(s.shared_len, p - 1) // chunk * chunk
+            tr.ledger.emit("span", trace_id=tid, span_id=sid,
+                           parent_id=par, name="prefill", rid=s.req.rid,
+                           start=round(s.start_ts, 6), end=round(now, 6),
+                           mode="chunked", chunk=chunk,
+                           chunks=-(-(p - first) // chunk),
+                           prompt_len=p, pages_fresh=s.n_fresh,
+                           pages_shared=s.n_shared,
+                           shared_len=s.shared_len,
+                           cow=s.cow_pending is not None,
+                           tenant=s.req.tenant, **tr.attrs())
+
+    # -- sequence-parallel prefill -----------------------------------------
+    def _prefill_sp(self, slot_idx, req, prompt, fresh, enq_ts, start_ts,
+                    match: Optional[PrefixMatch], bucket: int):
+        """Monolithic-shaped admission, sequence-parallel execution: the
+        prompt pads to an sp bucket and every device prefills ITS shard
+        under ring attention, scattering K/V into the pages the striped
+        allocation placed on it (_sp_prefill_program has the mechanics)."""
+        p = prompt.size
+        shared = list(match.pages) if match is not None else []
+        shared_len = match.cov if match is not None else 0
+        cow = None
+        if match is not None and match.partial:
+            cow = (match.full, shared[-1], fresh[-1])
+            bt_pages = shared + fresh[:-1]
+        else:
+            bt_pages = shared + fresh
+        bt = np.full((self.max_pages_per_seq,), self.pool.num_pages,
+                     np.int32)
+        bt[:len(bt_pages)] = bt_pages
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p] = prompt
+        program = _sp_prefill_program(self.model, self.sp_mesh,
+                                      self.cfg.temperature, self.cfg.top_k,
+                                      self.cfg.top_p)
+        # specializes per sp bucket, same contract as serve_prefill
+        register_audit_program("serve_sp_prefill", program,
+                               allowed=max(len(self.sp_buckets), 1))
+        tok, new_layers, self._rng = program(
+            self.params, self.pool.layers(),
+            jnp.asarray(self.pool.flat_block_table(bt[None])),
+            jnp.int32(p), jnp.int32(shared_len), jnp.asarray(padded),
+            self._rng)
+        self.pool.adopt(new_layers)
+        if self.cfg.prefix_cache:
+            self.pool.register_prefix(prompt, bt_pages,
+                                      skip_slots=len(shared))
+            self.prompt_pages += self.pool.pages_needed(p)
+            self.shared_prompt_pages += len(shared)
+        self.prefills += 1
+        self.sp_prefills += 1
+        # each device touches bucket/n rows: that's the wall the scheduler
+        # waited behind, so that's what the virtual clock charges
+        self.prefill_token_work += bucket // self.sp_n
+        # distlint: disable=DL002 -- iteration-level scheduling syncs once per admit by design
+        tok = int(jax.device_get(tok))
+        now = self._now()
+        slot = _Slot(req=req, pages=shared + fresh, block_table=bt,
+                     buf=np.zeros((p + req.max_new_tokens,), np.int32),
+                     prompt_len=p, admit_ts=enq_ts, start_ts=start_ts,
+                     position=p, generated=1, first_token_ts=now,
+                     cow_pending=cow, shared_len=shared_len,
+                     n_fresh=len(fresh), n_shared=len(shared),
+                     win_start_ts=now)
+        slot.buf[:p] = prompt
+        slot.buf[p] = tok
+        if (slot.generated >= req.max_new_tokens
+                or tok == self.cfg.eos_id):
+            slot.done = True
+            slot.finish_ts = now
+        self.slots[slot_idx] = slot
+        if self.tracer is not None:
+            tr = self.tracer
+            tid, sid, par = tr.ids(req.rid, "prefill")
+            tr.ledger.emit("span", trace_id=tid, span_id=sid,
+                           parent_id=par, name="prefill", rid=req.rid,
+                           start=round(start_ts, 6), end=round(now, 6),
+                           mode="sp", sp_devices=self.sp_n,
+                           bucket=bucket, prompt_len=p,
+                           pages_fresh=len(fresh),
+                           pages_shared=len(shared),
+                           shared_len=shared_len, cow=cow is not None,
+                           tenant=req.tenant, **tr.attrs())
+
     def _resolve_cow(self, active) -> None:
         """Fork every pending shared frontier page before this tick's
         writes: each forking sequence gets the page's bits duplicated onto
@@ -859,8 +1264,10 @@ class ServeEngine:
             s.cow_pending = None
 
     def _tick(self) -> None:
+        # a slot mid-chunked-prefill (chunk_next >= 0) has no token to
+        # decode yet — it keeps its pages but sits out the tick
         active = [(i, s) for i, s in enumerate(self.slots)
-                  if s is not None and not s.done]
+                  if s is not None and not s.done and s.chunk_next < 0]
         if not active:
             return
         self._resolve_cow(active)
@@ -876,12 +1283,14 @@ class ServeEngine:
             positions[i] = s.position
             bts[i] = s.block_table
         program = _tick_program(self.model, self.cfg.temperature,
-                                self.cfg.top_k, self.cfg.top_p)
+                                self.cfg.top_k, self.cfg.top_p,
+                                self.sp_mesh)
         # tick shapes are occupancy-invariant (inactive slots ride the
         # trash page), so ANY cache growth is a retrace hazard: allowed=1
         register_audit_program("serve_tick", program)
         nxt, new_layers, self._rng = program(
-            self.params, self.pool.layers(), jnp.asarray(bts),
+            self.params, self.pool.layers(),
+            jnp.asarray(self.pool.flat_block_table(bts)),
             jnp.asarray(tokens), jnp.asarray(positions), self._rng)
         self.pool.adopt(new_layers)
         # iteration-level scheduling: every tick's tokens come back to the
@@ -928,7 +1337,8 @@ class ServeEngine:
         register_audit_program("serve_spec_tick", program)
         emitted, emit_n, new_layers, new_dlayers = program(
             self.params, self.draft_params, self.pool.layers(),
-            self.draft_pool.layers(), jnp.asarray(bts),
+            self.draft_pool.layers(),
+            jnp.asarray(self.pool.flat_block_table(bts)),
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(caps))
         self.pool.adopt(new_layers)
         self.draft_pool.adopt(new_dlayers)
@@ -995,6 +1405,9 @@ class ServeEngine:
                          prefix_hits=st["prefix_hits"],
                          spec_emitted=self.spec_emitted,
                          spec_slot_ticks=self.spec_slot_ticks,
+                         sharded_devices=st["sharded_devices"],
+                         chunks_pending=self.chunks_pending,
+                         chunk_ticks=self.chunk_ticks,
                          slots=len(self.slots), tick=self.ticks)
 
     # -- introspection ----------------------------------------------------
@@ -1014,6 +1427,18 @@ class ServeEngine:
         return self.spec_emitted / self.spec_slot_ticks
 
     @property
+    def chunks_pending(self) -> int:
+        """Prefill chunks still owed to parked slots — the chunk-queue
+        depth the ledger's kv_cache events trend (a growing number means
+        admission outruns the one-chunk-per-iteration budget)."""
+        c = self.cfg.prefill_chunk
+        if c <= 0:
+            return 0
+        return sum(-(-(s.prompt_len - s.chunk_next) // c)
+                   for s in self.slots
+                   if s is not None and s.chunk_next >= 0)
+
+    @property
     def prefix_hit_rate(self) -> Optional[float]:
         """Share of prompt pages served from the prefix cache instead of
         freshly written (None until a prefix-cached prompt is admitted)."""
@@ -1026,6 +1451,10 @@ class ServeEngine:
         phr = self.prefix_hit_rate
         return {"ticks": self.ticks, "completed": self.completed,
                 "rejected": self.rejected, "prefills": self.prefills,
+                "sp_prefills": self.sp_prefills,
+                "chunk_ticks": self.chunk_ticks,
+                "chunks_pending": self.chunks_pending,
+                "prefill_token_work": self.prefill_token_work,
                 "occupancy": round(self.occupancy, 6),
                 "spec_k": self.cfg.spec_k,
                 "spec_emitted": self.spec_emitted,

@@ -232,7 +232,7 @@ class TransformerLM(nn.Module):
         new_layers = []
         ctx = (None if paged is None else
                {k: paged.get(k) for k in ("block_tables", "positions",
-                                          "lengths", "valid")})
+                                          "lengths", "valid", "sp_mesh")})
         for i in range(self.num_layers):
             blk = block_cls(self.num_heads, self.dtype, self.attn_fn,
                             self.quant, self.tp_impl, name=f"block{i}")

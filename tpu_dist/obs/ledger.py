@@ -82,9 +82,13 @@ EVENT_SCHEMA = {
     # paged KV pool pressure snapshot (engine.serve, periodic + final):
     # shared_pages/cow_copies/prefix_hits track cross-request prefix
     # sharing, spec_emitted/spec_slot_ticks the speculative acceptance
-    # trend; high_water_used/slots/tick ride as extras
+    # trend, sharded_devices the sp-mesh width of the pool (1 when
+    # unsharded) and chunks_pending the chunked-prefill backlog (the
+    # chunk-queue depth ledger_report trends); high_water_used/slots/
+    # tick/chunk_ticks ride as extras
     "kv_cache": ("pages_free", "pages_used", "active_seqs",
-                 "shared_pages", "cow_copies", "prefix_hits"),
+                 "shared_pages", "cow_copies", "prefix_hits",
+                 "sharded_devices", "chunks_pending"),
     # numerical-health trip (obs.health sentry: non-finite grads/loss or a
     # loss spike); action records what the policy did (record|skip|halt)
     "health": ("step", "kind", "policy", "action", "value"),

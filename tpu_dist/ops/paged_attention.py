@@ -57,8 +57,11 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
+from tpu_dist._compat import shard_map
 from tpu_dist.ops.flash_attention import _STAT_LANES, NEG_INF, _blocks, _fold
+from tpu_dist.parallel.mesh import SP_AXIS
 
 
 def pages_for(length: int, page_size: int) -> int:
@@ -151,6 +154,70 @@ def gather_pages(arena, block_table):
     g = arena[block_table]                       # (B, P, page_size, ...)
     b, p, s = g.shape[:3]
     return g.reshape((b, p * s) + g.shape[3:])
+
+
+# ---------------------------------------------------------------------------
+# sp-sharded arenas (engine.kv_cache sharded pool)
+# ---------------------------------------------------------------------------
+#
+# When the pool shards its arenas over the serving sequence-parallel axis
+# (``parallel.mesh.SP_AXIS``), dim 0 is laid out as ``n`` per-device blocks
+# of ``rows_local = pages_per_device + 1`` rows — each device carries its
+# own pages PLUS its own local trash row (the block's last row), so the
+# branch-free masked-write discipline survives sharding without any
+# cross-device scatter. Block tables then hold FLAT arena row indices
+# (``engine.kv_cache.PagedKVPool.flat_block_table``); ownership of row
+# ``r`` is ``r // rows_local``. The two collectives below are the ONLY
+# sharded-arena primitives: every read/write composes out of them, and for
+# a 1-device mesh both degenerate to the unsharded gather/scatter exactly.
+
+def _sp_local_bt(block_table, rows_local: int, me):
+    """Global flat rows -> this device's local rows; foreign rows route to
+    the LOCAL trash (rows_local - 1), which their owner will serve."""
+    owner = block_table // rows_local
+    local = jnp.where(owner == me, block_table % rows_local, rows_local - 1)
+    return owner, local
+
+
+def sp_gather_pages(arena, block_table, mesh):
+    """:func:`gather_pages` over an sp-sharded arena: each device gathers
+    the pages it owns (foreign entries masked to exact zeros) and one
+    ``psum`` over the sp axis assembles the full per-sequence view on
+    every device. Bit-exact: every page has exactly one owner, so each
+    output row is one contribution plus zeros."""
+
+    def gather(local_arena, bt):
+        rows_local = local_arena.shape[0]
+        me = jax.lax.axis_index(SP_AXIS)
+        owner, local_bt = _sp_local_bt(bt, rows_local, me)
+        g = gather_pages(local_arena, local_bt)      # (B, P*ps, ...)
+        own = jnp.repeat(owner == me, local_arena.shape[1], axis=1)
+        own = own.reshape(own.shape + (1,) * (g.ndim - 2))
+        g = jnp.where(own, g, jnp.zeros((), g.dtype))
+        return jax.lax.psum(g, SP_AXIS)
+
+    return shard_map(gather, mesh=mesh, in_specs=(P(SP_AXIS), P()),
+                     out_specs=P())(arena, block_table)
+
+
+def sp_paged_write(arena, block_table, positions, values, valid, mesh):
+    """:func:`paged_write` over an sp-sharded arena: every device sees the
+    (replicated) values and scatters exactly the rows whose page it owns;
+    everything else — foreign rows and masked rows alike — lands on the
+    device's LOCAL trash row. No communication at all: ownership is a
+    pure function of the flat row index."""
+
+    def write(local_arena, bt, pos, vals, ok):
+        rows_local = local_arena.shape[0]
+        me = jax.lax.axis_index(SP_AXIS)
+        _, local_bt = _sp_local_bt(bt, rows_local, me)
+        return paged_write(local_arena, local_bt, pos, vals, ok,
+                           rows_local - 1)
+
+    return shard_map(write, mesh=mesh,
+                     in_specs=(P(SP_AXIS), P(), P(), P(), P()),
+                     out_specs=P(SP_AXIS))(
+        arena, block_table, positions, values, valid)
 
 
 def _fork_arena(arena, src_pages, dst_pages):
@@ -354,7 +421,11 @@ def paged_attend(q, k, v, paged: dict, *, prefill: bool, attn_fn, dtype):
     ``paged`` carries the layer's arenas plus the shared context:
     ``{"layer": PagedLayer, "block_tables": (B, max_pages) i32,
     "positions": (B,) i32, "lengths": (B,) i32}`` plus an optional
-    ``"valid"`` (B, Lq) bool write mask. Prefill (``prefill=True``): the
+    ``"valid"`` (B, Lq) bool write mask and an optional ``"sp_mesh"``
+    (a static ``jax.sharding.Mesh`` carrying :data:`~tpu_dist.parallel.
+    mesh.SP_AXIS`): when set, the arenas are sp-sharded, the block tables
+    hold FLAT arena rows, and reads/writes route through
+    :func:`sp_gather_pages` / :func:`sp_paged_write`. Prefill (``prefill=True``): the
     queries attend within the prompt through the model's own ``attn_fn``
     (plain causal self-attention — nothing to read back), and the leading
     ``lengths[b]`` K/V rows are written to the pages — unless ``valid``
@@ -375,34 +446,50 @@ def paged_attend(q, k, v, paged: dict, *, prefill: bool, attn_fn, dtype):
     bt = paged["block_tables"]
     positions = paged["positions"]
     lengths = paged["lengths"]
+    sp_mesh = paged.get("sp_mesh")               # None = unsharded arenas
     trash = layer.num_pages                      # the extra page's index
 
     b, lq = q.shape[0], q.shape[1]
+    # unified write geometry: rows land at positions[b]..positions[b]+Lq-1.
+    # Monolithic prefill passes positions == 0 (identical indices to the
+    # old arange-only form); CHUNKED prefill and the sp prefill shard pass
+    # the chunk/shard's global start here, which is what lets one scatter
+    # serve whole-prompt, chunk-at-a-time, and per-device-shard writes.
+    write_pos = (positions[:, None].astype(jnp.int32)
+                 + jnp.arange(lq, dtype=jnp.int32)[None, :])      # (B, Lq)
     if prefill:
-        write_pos = jnp.broadcast_to(jnp.arange(lq, dtype=jnp.int32)[None],
-                                     (b, lq))
         valid = write_pos < lengths[:, None]
     else:
-        write_pos = (positions[:, None].astype(jnp.int32)
-                     + jnp.arange(lq, dtype=jnp.int32)[None, :])  # (B, Lq)
         valid = jnp.ones((b, lq), dtype=bool)
     if paged.get("valid") is not None:
         valid = valid & paged["valid"]
+
+    if sp_mesh is None:
+        def write(arena, vals):
+            return paged_write(arena, bt, write_pos, vals, valid, trash)
+
+        def read(arena):
+            return gather_pages(arena, bt)
+    else:
+        # sp-sharded arenas: block tables hold FLAT rows, ownership is
+        # row // rows_local, and the collectives above do the routing
+        def write(arena, vals):
+            return sp_paged_write(arena, bt, write_pos, vals, valid,
+                                  sp_mesh)
+
+        def read(arena):
+            return sp_gather_pages(arena, bt, sp_mesh)
 
     if layer.quant == "int8":
         kq, ks = _quantize_rows(k)
         vq, vs = _quantize_rows(v)
         new_layer = layer.replace(
-            k=paged_write(layer.k, bt, write_pos, kq, valid, trash),
-            v=paged_write(layer.v, bt, write_pos, vq, valid, trash),
-            k_scale=paged_write(layer.k_scale, bt, write_pos, ks, valid,
-                                trash),
-            v_scale=paged_write(layer.v_scale, bt, write_pos, vs, valid,
-                                trash))
+            k=write(layer.k, kq), v=write(layer.v, vq),
+            k_scale=write(layer.k_scale, ks),
+            v_scale=write(layer.v_scale, vs))
     else:
         new_layer = layer.replace(
-            k=paged_write(layer.k, bt, write_pos, k, valid, trash),
-            v=paged_write(layer.v, bt, write_pos, v, valid, trash))
+            k=write(layer.k, k), v=write(layer.v, v))
 
     if prefill:
         # causal self-attention over the prompt itself — exactly the
@@ -412,20 +499,21 @@ def paged_attend(q, k, v, paged: dict, *, prefill: bool, attn_fn, dtype):
     if layer.quant == "int8" and layer.read == "flash" and lq == 1:
         # the Pallas kernel is one-query-per-row (the decode tick); the
         # Lq > 1 verify window reads through the exact dequant path below
-        # — same math, and verify dispatches are 1-in-k ticks by design
+        # — same math, and verify dispatches are 1-in-k ticks by design.
+        # Under an sp-sharded pool the gathered view is replicated by the
+        # psum, so the kernel composes UNCHANGED — sharding lives entirely
+        # in the gather.
         out = int8kv_paged_flash_attention_fn()(
-            q, gather_pages(new_layer.k, bt),
-            gather_pages(new_layer.k_scale, bt),
-            gather_pages(new_layer.v, bt),
-            gather_pages(new_layer.v_scale, bt),
+            q, read(new_layer.k), read(new_layer.k_scale),
+            read(new_layer.v), read(new_layer.v_scale),
             positions + 1)
         return out.astype(q.dtype), new_layer
 
-    gk = gather_pages(new_layer.k, bt)
-    gv = gather_pages(new_layer.v, bt)
+    gk = read(new_layer.k)
+    gv = read(new_layer.v)
     if layer.quant == "int8":
         gk = (gk.astype(jnp.float32)
-              * gather_pages(new_layer.k_scale, bt)[..., None]).astype(dtype)
+              * read(new_layer.k_scale)[..., None]).astype(dtype)
         gv = (gv.astype(jnp.float32)
-              * gather_pages(new_layer.v_scale, bt)[..., None]).astype(dtype)
+              * read(new_layer.v_scale)[..., None]).astype(dtype)
     return masked_attention(q, gk, gv, positions), new_layer

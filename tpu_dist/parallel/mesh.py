@@ -34,6 +34,7 @@ MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 STAGE_AXIS = "stage"    # pipeline parallel (parallel.pp)
 EXPERT_AXIS = "expert"  # MoE expert parallel (parallel.ep)
+SP_AXIS = "sp"          # serving sequence parallel (engine.serve long-context)
 
 
 def make_mesh(shape: Optional[Sequence[int]] = None,

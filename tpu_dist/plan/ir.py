@@ -46,7 +46,7 @@ PLAN_VERSION = 1
 
 # the mesh-axis vocabulary (parallel/mesh.py *_AXIS authority, mirrored
 # jax-free; tests AST-extract mesh.py and assert this tuple matches)
-KNOWN_AXES = ("data", "fsdp", "model", "seq", "stage", "expert")
+KNOWN_AXES = ("data", "fsdp", "model", "seq", "stage", "expert", "sp")
 
 ENGINES = ("image", "lm")
 LAYOUTS = ("dp", "tp", "sp")
