@@ -216,6 +216,58 @@ assert all(b["exemplars"] for b in summary["slo_exemplars"]), \
 print("reqtrace gate: OK (no jax, deterministic)")
 EOF
 
+# Autoscaling gate (round 20), jax-free BY CONSTRUCTION: the capacity
+# monitor closes the observability->capacity loop, so its policy grammar,
+# the checked-in acceptance scenario, and the decision replay must all
+# run on a bare login/CI host — and the replay must be DETERMINISTIC
+# (decision ids, attribution, ordering), because the fleet report and
+# the supervisor's applied follow-ups all key on the decision id. The
+# canned fixture is built twice from fresh loads and must produce
+# byte-identical decisions, pinned to the [up, down] pair it encodes.
+python - <<'EOF'
+import builtins, json
+
+_real = builtins.__import__
+def _guard(name, *a, **k):
+    if name == "jax" or name.startswith("jax."):
+        raise ImportError(f"autoscale gate: jax import blocked ({name})")
+    return _real(name, *a, **k)
+builtins.__import__ = _guard
+
+from tpu_dist.obs.autoscale import AutoscalePolicy, replay_decisions
+from tpu_dist.sim.scenario import compile_host_plans, load_scenario
+
+pol = AutoscalePolicy.load("scripts/autoscale_policy.json")
+assert pol.min_hosts == 2 and pol.max_hosts == 3, pol
+assert pol.down.stable_ticks >= 1, "down-side hysteresis lost"
+
+# the acceptance scenario parses and compiles deterministically with its
+# autoscale block (standby host parked, policy by repo-relative path)
+sc = load_scenario("scripts/fleet_autoscale.json")
+assert sc.standby_hosts() == [2], sc.autoscale
+p1, a1 = compile_host_plans(sc)
+p2, a2 = compile_host_plans(sc)
+assert ([(x.tick, x.rid, x.tenant, x.prompt_len, x.out_len)
+         for h in sorted(p1) for x in p1[h].arrivals] ==
+        [(x.tick, x.rid, x.tenant, x.prompt_len, x.out_len)
+         for h in sorted(p2) for x in p2[h].arrivals]) and a1 == a2
+
+def replay():
+    with open("tests/fixtures/autoscale/ledger.jsonl") as f:
+        recs = [json.loads(line) for line in f]
+    return replay_decisions(
+        recs, AutoscalePolicy.load("scripts/autoscale_policy.json"),
+        hosts0=2)
+
+d1, d2 = replay(), replay()
+assert json.dumps(d1) == json.dumps(d2), \
+    "decision replay is not deterministic"
+assert [(d["decision"], d["direction"], d["signal"]) for d in d1] == \
+    [("d0", "up", "slo_breaches_window"), ("d1", "down", "calm_ticks")], d1
+assert d1[0]["tick"] == 14 and d1[1]["tick"] == 64, d1
+print("autoscale gate: OK (no jax, deterministic)")
+EOF
+
 # Program-audit gate (round 18): proglint over every plan in the tuner's
 # canned-CI candidate space (scripts/tune_ci.json names the device kind).
 # Unlike the gates above this one NEEDS jax — it traces real programs —

@@ -48,6 +48,13 @@ def emit_plan_badly(ledger):
     ledger.emit("tune", device_kind="v5e")       # missing candidates/best
 
 
+def emit_autoscale_badly(ledger, dec):
+    # round 20: the autoscaling decision + its applied follow-up are
+    # schema-checked like the rest — attribution must be explicit
+    ledger.emit("scale_decision", direction="up")  # missing attribution
+    ledger.emit("applied", **dec)                  # required in a splat
+
+
 def emit_audit_badly(ledger, meta):
     # round 18: the program-audit event (analysis.proglint via
     # plan.compile) is schema-checked like the rest

@@ -81,6 +81,18 @@ def emit_plan_well(ledger):
                 measured=True, peaks_nominal=False)
 
 
+def emit_autoscale_well(ledger):
+    # round 20: the autoscaling decision (obs.autoscale.emit_decision)
+    # and the supervisor's applied follow-up — full attribution required
+    # (tick and the retune's device count ride as extras)
+    ledger.emit("scale_decision", decision="d0", direction="up",
+                hosts_from=2, target_hosts=3, signal="queue_wait_ema_s",
+                value=0.105, threshold=0.08, window_ticks=16,
+                bundle=None, tick=48)
+    ledger.emit("applied", decision="d0", action="expand", processes=3,
+                epoch=1, plan_hash="31cea7bec68a", devices=6)
+
+
 def emit_audit_well(ledger):
     # round 18: the program-audit event (analysis.proglint findings,
     # emitted by plan.compile's audit pass) — findings is the UNWAIVERED

@@ -53,6 +53,7 @@ def moe_setup():
     return model, params, tx, inputs, targets
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): loss-goes-down smoke over the same moe_setup step whose math test_expert_parallel_matches_dp pins exactly in-budget
 def test_moe_lm_trains(moe_setup):
     model, params, tx, inputs, targets = moe_setup
     mesh = make_mesh((8,), ("data",))
@@ -281,6 +282,7 @@ def test_moe_remat_matches_no_remat(moe_setup):
     assert mem_remat < mem_plain, (mem_remat, mem_plain)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): composition of two single-axis parities that stay in-budget (test_expert_parallel_matches_dp, test_lm.py::test_tp_matches_dp) — the PR 11 dp x tp convention
 def test_moe_tp_composition_matches_dp(moe_setup):
     """MoE x TP (VERDICT r3 #4): a (data=2, expert=2, model=2) mesh with
     expert weights Megatron-split over 'model' on top of their 'expert'

@@ -168,6 +168,7 @@ def test_pp_1f1b_loss_chunk_matches_dp():
             rtol=2e-5, atol=1e-7, err_msg=str(path))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): multi-step convergence twin of the exact single-step parities that stay in-budget (test_pp_step_matches_dp, test_pp_1f1b_loss_chunk_matches_dp)
 def test_pp_multiple_steps_converge():
     """Loss decreases over repeated pp steps (end-to-end sanity)."""
     lm, params, tx, inputs, targets = _setup()

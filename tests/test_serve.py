@@ -396,6 +396,7 @@ def test_spec_decode_greedy_bit_identical_to_generate():
     assert eng.ticks < max(steps)         # sublinear in emitted tokens
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): quantized twin of test_spec_decode_greedy_bit_identical_to_generate (in-budget); the int8_wo path itself stays pinned by test_int8_kv_exact_and_flash_kernel_agree
 def test_spec_decode_bit_identical_int8_wo():
     """The quantized twin: the draft rides the same int8_wo tree through
     the memoized quantize path; the verified stream stays bitwise the

@@ -138,6 +138,10 @@ def load_points(paths: List[str], out_err=None) -> List[dict]:
             "serving_ttfl": serving.get("ttft_long_p99"),
             "serving_tip": serving.get("tpot_interference_pct"),
             "fleet_goodput": fleet.get("goodput_ratio"),
+            # round 20+: autoscale reaction time — ticks from burst onset
+            # to the first up decision, LOWER is better; pre-autoscale
+            # history carries no field and abstains like the rest
+            "fleet_lag": fleet.get("autoscale_lag_ticks"),
             "round": rnd,
             "file": os.path.basename(path),
         })
@@ -251,6 +255,17 @@ def track(points: List[dict], threshold_pct: float,
                            and fleet_latest is not None
                            and (fleet_best - fleet_latest) / fleet_best
                            * 100.0 > threshold_pct)
+        # autoscale reaction lag (round 20+): LOWER is better — judged
+        # against the best (lowest) prior carrying the field, fails on
+        # RISE; a zero best prior abstains (no relative scale to judge)
+        prior_lag = [p["fleet_lag"] for p in prior
+                     if p.get("fleet_lag") is not None]
+        lag_best = min(prior_lag, default=None)
+        lag_latest = latest.get("fleet_lag")
+        lag_regressed = (lag_best is not None and lag_latest is not None
+                         and lag_best > 0
+                         and (lag_latest - lag_best) / lag_best * 100.0
+                         > threshold_pct)
         rounds = [{"round": p["round"], "value": p["value"],
                    "mfu": p["mfu"], "file": p["file"],
                    "data_s": p.get("data_s"),
@@ -280,6 +295,9 @@ def track(points: List[dict], threshold_pct: float,
             "fleet_latest": fleet_latest,
             "fleet_best_prior": fleet_best,
             "fleet_regressed": fleet_regressed,
+            "autoscale_lag_latest": lag_latest,
+            "autoscale_lag_best_prior": lag_best,
+            "autoscale_lag_regressed": lag_regressed,
             "ttft_long_latest": ttfl_latest,
             "ttft_long_best_prior": ttfl_best,
             "ttft_long_regressed": ttfl_regressed,
@@ -289,7 +307,7 @@ def track(points: List[dict], threshold_pct: float,
         }
         if (regressed or data_regressed or srv_regressed or apt_regressed
                 or ppr_regressed or cov_regressed or fleet_regressed
-                or ttfl_regressed or tip_regressed):
+                or ttfl_regressed or tip_regressed or lag_regressed):
             report["ok"] = False
     return report
 
@@ -404,6 +422,19 @@ def render(report: dict, out=print) -> None:
             else:
                 out(f"  -> fleet: goodput ratio {m['fleet_latest']:.4f} "
                     "(no prior fleet history; nothing to judge)")
+        if m.get("autoscale_lag_latest") is not None:
+            if m.get("autoscale_lag_best_prior") is not None:
+                verdict = ("AUTOSCALE-LAG REGRESSED"
+                           if m["autoscale_lag_regressed"] else "ok")
+                out(f"  -> autoscale {verdict}: lag "
+                    f"{m['autoscale_lag_latest']:.1f} tick(s) vs best "
+                    f"(lowest) prior {m['autoscale_lag_best_prior']:.1f} "
+                    f"(threshold {report['threshold_pct']:g}%, lower is "
+                    "better)")
+            else:
+                out(f"  -> autoscale: lag {m['autoscale_lag_latest']:.1f} "
+                    "tick(s) (no prior autoscale history; nothing to "
+                    "judge)")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -461,7 +492,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                or m.get("serving_regressed") or m.get("accepted_regressed")
                or m.get("pages_regressed") or m.get("coverage_regressed")
                or m.get("fleet_regressed") or m.get("ttft_long_regressed")
-               or m.get("interference_regressed")]
+               or m.get("interference_regressed")
+               or m.get("autoscale_lag_regressed")]
         print(f"bench_track: REGRESSION in {bad}", file=sys.stderr)
         return 1
     return 0

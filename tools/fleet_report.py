@@ -13,8 +13,11 @@ and restart gap, with the sum-check that proves categories + goodput
 account for ~100% of the aggregate wall), the restart-class histogram
 and per-host class lists (`classify_attempt` in report mode), the
 fleet-wide SLO-breach count, the cross-host elasticity timeline (every
-``scale`` event on the fleet clock), per-tenant request percentiles, and
-the hosts-live timeline from the runner's periodic ``fleet`` events.
+``scale`` event on the fleet clock), per-tenant request percentiles,
+the hosts-live timeline from the runner's periodic ``fleet`` events, and
+— when the run autoscaled — the decision audit (every ``scale_decision``
+with its attribution, the paired scale event's lag, and the retuned plan
+hash from the ``applied`` follow-up).
 
 ``--json`` prints :meth:`FleetLedger.report` verbatim — the stable input
 the CI acceptance (tests/test_fleet.py) asserts into. Per-host detail
@@ -125,6 +128,28 @@ def render(report: dict, out=print) -> None:
     if live:
         peak = max((r.get("hosts_live") or 0) for r in live)
         out(f"\nhosts-live timeline: {len(live)} snapshot(s), peak {peak}")
+    auto = report.get("autoscale")
+    if auto:
+        rows = auto.get("decisions") or []
+        out(f"\nautoscale: {len(rows)} decision(s), {auto.get('paired')} "
+            f"paired 1:1 with a scale event, "
+            f"{auto.get('unattributed_scales')} unattributed scale "
+            f"event(s), {auto.get('applied_with_plan_hash')} applied with "
+            f"a retuned plan hash, {auto.get('shed_lost')} shed request(s) "
+            "lost")
+        for r in rows:
+            out(f"  +{r['t_rel']:8.1f}s  {r['decision']}: "
+                f"{r.get('direction')} {r.get('hosts_from')}"
+                f"->{r.get('target_hosts')} host(s) @tick {r.get('tick')} "
+                f"— {r.get('signal')}={r.get('value')} vs "
+                f"{r.get('threshold')} over {r.get('window_ticks')} "
+                "tick(s)"
+                + (f"; scaled after {r['lag_s']:.1f}s"
+                   if r.get("lag_s") is not None else "; UNPAIRED")
+                + (f"; plan {r['applied']['plan_hash']} @epoch "
+                   f"{r['applied']['epoch']}"
+                   if (r.get("applied") or {}).get("plan_hash") else "")
+                + (f"; bundle {r['bundle']}" if r.get("bundle") else ""))
 
 
 def main(argv=None) -> int:

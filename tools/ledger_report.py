@@ -315,6 +315,47 @@ def elasticity_section(records, out=print):
     return rows
 
 
+def decisions_section(records, out=print):
+    """The autoscaling audit (round 20, obs.autoscale): every
+    ``scale_decision`` the capacity monitor emitted (a fleet ledger read
+    directly, or any stream carrying them) and every ``applied``
+    follow-up the supervisor stamped after re-tuning at the new world
+    size — rendered in wall order so decision -> rescale -> new plan hash
+    reads as one story. ``None`` when the stream has neither."""
+    rows = sorted((r for r in records
+                   if r["event"] in ("scale_decision", "applied")),
+                  key=lambda r: r.get("ts") or 0.0)
+    if not rows:
+        return None
+    t0 = min((r.get("ts") for r in records if r.get("ts") is not None),
+             default=0)
+    n_dec = sum(1 for r in rows if r["event"] == "scale_decision")
+    out(f"\nautoscale decisions ({n_dec} decision(s), "
+        f"{len(rows) - n_dec} applied):")
+    summary = []
+    for r in rows:
+        dt = (r.get("ts") or t0) - t0
+        if r["event"] == "scale_decision":
+            out(f"  +{dt:8.1f}s  {r.get('decision')}: {r.get('direction')} "
+                f"{r.get('hosts_from')} -> {r.get('target_hosts')} host(s) "
+                f"— {r.get('signal')}={r.get('value')} vs "
+                f"{r.get('threshold')} over {r.get('window_ticks')} tick(s)"
+                + (f", bundle {r['bundle']}" if r.get("bundle") else ""))
+            summary.append({k: r.get(k) for k in
+                            ("decision", "direction", "hosts_from",
+                             "target_hosts", "signal", "value", "threshold",
+                             "window_ticks", "bundle", "ts")})
+        else:
+            out(f"  +{dt:8.1f}s  {r.get('decision') or '(organic)'} "
+                f"applied: {r.get('action')} -> {r.get('processes')} "
+                f"process(es) epoch {r.get('epoch')}, plan hash "
+                f"{r.get('plan_hash')}")
+            summary.append({k: r.get(k) for k in
+                            ("decision", "action", "processes", "epoch",
+                             "plan_hash", "ts")})
+    return summary
+
+
 def decode_section(records, out=print):
     """The serving-SLO section: per-request latency percentiles and tok/s
     over the `decode` events (engine.generate / tools/decode_bench), plus
@@ -570,6 +611,8 @@ def summarize(records, out=print):
     # elastic-capacity timeline (round 13): shrink -> degraded attempts ->
     # re-expansion, preemption snapshots, peer restores, serve drains
     summary["elasticity"] = elasticity_section(records, out=out)
+    # autoscaling audit (round 20): scale_decision + applied follow-ups
+    summary["autoscale"] = decisions_section(records, out=out)
 
     if steps:
         # warm records carry the XLA compile in dispatch_s; exclude them

@@ -277,7 +277,15 @@ def merge_job(groups: list, sup_records: list = ()) -> dict:
         prev_end = att_end
     scales = [r for r in (sup_records or ())
               if r.get("event") == "scale" and r.get("ts") is not None]
-    if scales and job_t0 is not None:
+    # autoscaling markers (round 20, obs.autoscale): the capacity
+    # monitor's scale_decision events and the supervisor's applied
+    # follow-ups render as instants on the SAME supervisor lane, beside
+    # the scale events they attribute — decision -> rescale -> new plan
+    # hash reads left to right on one timeline
+    decisions = [r for r in (sup_records or ())
+                 if r.get("event") in ("scale_decision", "applied")
+                 and r.get("ts") is not None]
+    if (scales or decisions) and job_t0 is not None:
         # the supervisor lane: one stride past the HIGHEST attempt
         # ordinal (lane offsets key on the filename-stamped ordinal, not
         # list position — a lost intermediate attempt must not make this
@@ -294,12 +302,26 @@ def merge_job(groups: list, sup_records: list = ()) -> dict:
                 "pid": sup_pid, "tid": 0,
                 "ts": max((r["ts"] - job_t0) * 1e6, 0.0), "s": "g",
                 "args": _args(r, ("action", "processes", "epoch", "hosts",
-                                  "step", "world_from", "shed"))})
+                                  "step", "world_from", "shed",
+                                  "decision"))})
+        for r in decisions:
+            name = (f"decision:{r.get('direction')}"
+                    if r["event"] == "scale_decision"
+                    else f"applied:{r.get('action')}")
+            events.append({
+                "ph": "i", "name": name, "pid": sup_pid, "tid": 0,
+                "ts": max((r["ts"] - job_t0) * 1e6, 0.0), "s": "g",
+                "args": _args(r, ("decision", "direction", "hosts_from",
+                                  "target_hosts", "signal", "value",
+                                  "threshold", "window_ticks", "bundle",
+                                  "action", "processes", "epoch",
+                                  "plan_hash"))})
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"tool": "tpu_dist tools/trace_merge.py",
                           "processes": lanes,
                           "attempts": len(groups),
                           "scale_events": len(scales),
+                          "autoscale_events": len(decisions),
                           "clock": ("per-process, zeroed at attempt 0's "
                                     "run_start" if multi else
                                     "per-process, zeroed at run_start")}}

@@ -126,6 +126,24 @@ EVENT_SCHEMA = {
     # consensus is configured); hosts/step/world_from ride as extras.
     # ledger_report stitches these into the elasticity timeline
     "scale": ("action", "processes", "epoch"),
+    # one autoscaling decision (obs.autoscale CapacityMonitor under an
+    # AutoscalePolicy): direction (up|down), the capacity transition
+    # (hosts_from -> target_hosts), and the FULL attribution — which
+    # signal tripped, its value vs threshold, the evaluation window, and
+    # the newest flight-recorder bundle reference (None when no diagnosis
+    # preceded it) — so "why did we scale" reads from the ledger alone.
+    # The fleet tick rides as an extra; the executing scale event stamps
+    # the decision id as its own `decision` extra (1:1 pairing)
+    "scale_decision": ("decision", "direction", "hosts_from",
+                       "target_hosts", "signal", "value", "threshold",
+                       "window_ticks", "bundle"),
+    # the decision's follow-up (parallel.supervisor after the rescale
+    # relaunch): which decision was applied, the executed action
+    # (shrink|expand), the post-transition world size and consensus
+    # epoch, and the plan_hash of the deterministic plan/tune.py re-run
+    # at the new world size (None when no retune is configured) — the
+    # PR 15 retune-on-rescale residue, closed and auditable
+    "applied": ("decision", "action", "processes", "epoch", "plan_hash"),
     # fleet-simulation identity (tpu_dist.sim.runner): the scenario one
     # fleet run executed — name/seed/hosts/ticks pin the deterministic
     # schedule so a fleet report is self-describing; tick_s/events ride

@@ -405,6 +405,18 @@ def metrics_ledger_sink(reg: MetricsRegistry):
                                  "fleet-wide SLO breaches (monotonic; "
                                  "fed by deltas of the fleet events' "
                                  "cumulative count)")
+    # autoscaling (obs.autoscale `scale_decision` events): decisions by
+    # direction plus the last decision's target capacity — the dashboard
+    # face of the closed capacity loop. Directions pre-registered so a
+    # steady fleet still scrapes explicit zeros
+    autoscale_decisions = reg.counter(
+        "tpu_dist_autoscale_decisions_total",
+        "autoscaling decisions emitted, by direction")
+    autoscale_decisions.labels(direction="up")
+    autoscale_decisions.labels(direction="down")
+    autoscale_target = reg.gauge(
+        "tpu_dist_autoscale_target_hosts",
+        "target host count of the last autoscaling decision")
     # program-audit findings (tpu_dist.analysis.proglint 'audit' events)
     # by check id; pre-registered so a clean run still scrapes zeros
     audit_findings = reg.counter("tpu_dist_audit_findings_total",
@@ -422,7 +434,7 @@ def metrics_ledger_sink(reg: MetricsRegistry):
               straggler, epoch_g, eval_loss, hbm, decode_toks, step_hist,
               goodput_ratio, serve_queue, serve_active, kv_free, serve_reqs,
               serve_rejects, serve_toks, req_ttft, mesh_procs, degraded_g,
-              fleet_ratio, fleet_hosts, fleet_breaches):
+              fleet_ratio, fleet_hosts, fleet_breaches, autoscale_target):
         m.labels()
 
     def sink(rec: dict) -> None:
@@ -528,6 +540,11 @@ def metrics_ledger_sink(reg: MetricsRegistry):
                 degraded_g.set(1.0)
             elif act == "expand":
                 degraded_g.set(0.0)
+        elif ev == "scale_decision":
+            autoscale_decisions.labels(
+                direction=rec.get("direction") or "unknown").inc()
+            if rec.get("target_hosts") is not None:
+                autoscale_target.set(rec["target_hosts"])
         elif ev == "audit":
             for d in (rec.get("detail") or ()):
                 if not d.get("waived"):

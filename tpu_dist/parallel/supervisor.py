@@ -356,7 +356,8 @@ class Supervisor:
                  consensus: Optional[ConsensusDir] = None,
                  consensus_poll_s: float = 1.0,
                  on_attempt: Optional[Callable[["AttemptResult"],
-                                               None]] = None):
+                                               None]] = None,
+                 retune: Optional[Dict] = None):
         if not cmd:
             raise ValueError("supervisor needs a training command "
                              "(everything after '--')")
@@ -390,6 +391,15 @@ class Supervisor:
         # every classified attempt and can end the policy loop externally
         self.on_attempt = on_attempt
         self._stop = threading.Event()
+        # autoscaling (round 20, obs.autoscale): with a retune config
+        # ({device_kind, devices_per_host, plan_dir, workload?,
+        # measurement_files?}) every world-size transition re-runs
+        # plan.tune deterministically at the new size and stamps the plan
+        # hash into an `applied` event; the fleet driver sets
+        # `autoscale_decision` just before the membership change so the
+        # resulting scale + applied events carry the decision id
+        self.retune = dict(retune) if retune else None
+        self.autoscale_decision: Optional[str] = None
 
     def request_stop(self) -> None:
         """Ask the policy loop to end (thread-safe, callable from any
@@ -458,17 +468,64 @@ class Supervisor:
         # is still an expansion that needs the peer-resume relaunch
         world_from = prev.world_size if prev is not None else view.planned
         if view.world_size < world_from:
+            dec, self.autoscale_decision = self.autoscale_decision, None
             self._emit_scale("shrink", view.world_size, view.epoch,
-                             hosts=list(view.hosts), world_from=world_from)
+                             hosts=list(view.hosts), world_from=world_from,
+                             decision=dec)
+            self._maybe_retune(view, "shrink", dec)
         elif view.world_size > world_from:
+            dec, self.autoscale_decision = self.autoscale_decision, None
             self._emit_scale("expand", view.world_size, view.epoch,
-                             hosts=list(view.hosts), world_from=world_from)
+                             hosts=list(view.hosts), world_from=world_from,
+                             decision=dec)
+            self._maybe_retune(view, "expand", dec)
             # the grown world: a returning host has no local checkpoint,
             # so dp-pure engines pull state from a survivor over the wire
             # (engine.checkpoint.peer_restore_state)
             self._peer_resume_next = True
         self._view = view
         return view
+
+    def _maybe_retune(self, view: MeshView, action: str,
+                      decision: Optional[str]) -> None:
+        """Close the decision's follow-up: re-run the deterministic plan
+        autotuner (plan.tune — pure arithmetic, jax-free) at the NEW
+        world size and stamp its best-plan hash into an ``applied`` event
+        beside the scale event. The audit contract: a byte-identical
+        re-run of tune at the same world size must reproduce the hash."""
+        if not self.retune:
+            return
+        kind = self.retune.get("device_kind", "TPU v5 lite")
+        devices = view.world_size * int(
+            self.retune.get("devices_per_host", 1))
+        plan_hash = None
+        try:
+            from tpu_dist.plan.tune import tune
+            text, results = tune(
+                measurement_files=self.retune.get("measurement_files"),
+                device_kinds=[kind],
+                workload={**(self.retune.get("workload") or {}),
+                          "devices": devices})
+            best = (results.get(kind) or {}).get("best")
+            plan_hash = best["hash"] if best else None
+            plan_dir = self.retune.get("plan_dir")
+            if plan_dir:
+                os.makedirs(plan_dir, exist_ok=True)
+                with open(os.path.join(
+                        plan_dir, f"plan_epoch{view.epoch}.json"), "w") as f:
+                    f.write(text)
+        except Exception as e:
+            self._log(f"warning: retune at world {view.world_size} "
+                      f"failed ({e})")
+        self._ensure_scale_ledger()
+        if self._scale_ledger:
+            try:
+                self._scale_ledger.emit(
+                    "applied", decision=decision, action=action,
+                    processes=view.world_size, epoch=view.epoch,
+                    plan_hash=plan_hash, devices=devices)
+            except Exception as e:
+                self._log(f"warning: applied event dropped ({e})")
 
     # -- one attempt ----------------------------------------------------
     def _child_argv(self, resume: Optional[str]) -> List[str]:

@@ -166,9 +166,68 @@ class FleetLedger:
                              "t_rel": round((r.get("ts") or t0) - t0, 6),
                              **{k: r.get(k) for k in
                                 ("action", "processes", "epoch", "hosts",
-                                 "step", "world_from", "shed")}})
+                                 "step", "world_from", "shed",
+                                 "decision")}})
         rows.sort(key=lambda r: (r["t_rel"], r["host"]))
         return rows
+
+    def autoscale(self) -> Optional[dict]:
+        """The decision audit: every ``scale_decision`` the capacity
+        monitor emitted (runner fleet ledger), each joined with the scale
+        event(s) the supervisor attributed to it (``decision`` stamp) and
+        the ``applied`` follow-up carrying the retuned plan hash. The
+        acceptance invariant is the pairing: ``unattributed_scales == 0``
+        and every decision's ``scale_events == 1`` means capacity never
+        moved except under an auditable decision. ``None`` when the run
+        had no autoscaling (fixed-capacity fleets stay unchanged)."""
+        decisions = [r for r in self.fleet_records
+                     if r.get("event") == "scale_decision"]
+        if not decisions:
+            return None
+        t0 = self.t0() or 0.0
+        scales = self.elasticity()
+        applied = []
+        for h, recs in self.hosts.items():
+            for r in recs:
+                if r.get("event") != "applied":
+                    continue
+                applied.append({"host": h,
+                                "t_rel": round((r.get("ts") or t0) - t0, 6),
+                                **{k: r.get(k) for k in
+                                   ("decision", "action", "processes",
+                                    "epoch", "plan_hash")}})
+        rows = []
+        for d in decisions:
+            did = d.get("decision")
+            match = [s for s in scales if s.get("decision") == did]
+            app = [a for a in applied if a.get("decision") == did]
+            t_rel = round((d.get("ts") or t0) - t0, 6)
+            rows.append({
+                "decision": did, "t_rel": t_rel, "tick": d.get("tick"),
+                **{k: d.get(k) for k in
+                   ("direction", "hosts_from", "target_hosts", "signal",
+                    "value", "threshold", "window_ticks", "bundle")},
+                "scale_events": len(match),
+                "lag_s": (round(match[0]["t_rel"] - t_rel, 6)
+                          if match else None),
+                "applied": app[0] if app else None})
+        rows.sort(key=lambda r: (r["t_rel"], r["decision"] or ""))
+        traces = self.traces()
+        return {
+            "decisions": rows,
+            "paired": sum(1 for r in rows if r["scale_events"] == 1),
+            # only MEMBERSHIP actions need attribution: drains/snapshots
+            # are per-host mechanics, not capacity changes
+            "unattributed_scales": sum(
+                1 for s in scales
+                if s.get("action") in ("shrink", "expand")
+                and s.get("decision") is None),
+            "applied_with_plan_hash": sum(
+                1 for r in rows
+                if (r["applied"] or {}).get("plan_hash") is not None),
+            "shed_lost": sum(1 for tr in traces.values()
+                             if tr["sheds"] and not tr["completed"]),
+        }
 
     def per_tenant(self) -> Dict[str, dict]:
         """Per-tenant serving percentiles over the fleet's ``request``
@@ -236,7 +295,8 @@ class FleetLedger:
         t0 = self.t0() or 0.0
         return [{"t_rel": round((r.get("ts") or t0) - t0, 6),
                  "hosts_live": r.get("hosts_live"),
-                 "slo_breaches": r.get("slo_breaches")}
+                 "slo_breaches": r.get("slo_breaches"),
+                 "tick": r.get("tick")}
                 for r in self.fleet_records if r.get("event") == "fleet"]
 
     def report(self) -> dict:
@@ -259,4 +319,5 @@ class FleetLedger:
             "serving": self.serving_totals(),
             "traces": self.traces(),
             "hosts_live": self.hosts_live_timeline(),
+            "autoscale": self.autoscale(),
         }

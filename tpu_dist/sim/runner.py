@@ -33,6 +33,7 @@ Outputs under ``out_dir``::
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -40,6 +41,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from tpu_dist.obs.autoscale import (AutoscalePolicy, CapacityMonitor,
+                                    LedgerTailer, emit_decision)
 from tpu_dist.obs.ledger import Ledger
 from tpu_dist.obs.metrics import MetricsRegistry, metrics_ledger_sink
 from tpu_dist.parallel.consensus import ConsensusDir
@@ -84,6 +87,21 @@ class FleetSim:
         self.results: Dict[int, object] = {}
         self._sups: Dict[int, Supervisor] = {}
         self._breaches = 0
+        # autoscaling (round 20, obs.autoscale): standby hosts start
+        # parked; the CapacityMonitor, fed by tailing every host ledger,
+        # decides when they join (and when elastic hosts leave again)
+        auto = self.sc.autoscale or {}
+        pol = auto.get("policy")
+        if isinstance(pol, str) and not os.path.exists(pol):
+            # checked-in scenarios name their policy repo-relative
+            # (scripts/autoscale_policy.json) — resolve it from anywhere
+            pol = os.path.join(_REPO_ROOT, pol)
+        self.policy: Optional[AutoscalePolicy] = (
+            None if pol is None else
+            AutoscalePolicy.from_doc(pol) if isinstance(pol, dict)
+            else AutoscalePolicy.load(pol))
+        self.standby = set(self.sc.standby_hosts())
+        self.decisions: List[dict] = []
 
     # -- wiring -----------------------------------------------------------
     def _host_dir(self, h: int) -> str:
@@ -111,13 +129,30 @@ class FleetSim:
             stall_timeout_s=self.stall_timeout_s,
             # the sim's SIGTERM faults are the schedule, not host loss
             shrink_on_host_loss=False)
-        consensus = (ConsensusDir(cdir, h, planned=sc.hosts, lease_s=3600.0)
+        consensus = (ConsensusDir(cdir, h, planned=self._planned(),
+                                  lease_s=3600.0)
                      if h == sc.consensus_host else None)
+        # with a policy configured, the consensus host re-tunes the plan
+        # deterministically at every new world size (the PR 15
+        # retune-on-rescale residue) and stamps its hash into the
+        # decision's `applied` follow-up event
+        retune = None
+        if consensus is not None and self.policy is not None:
+            retune = {"device_kind": "TPU v5 lite",
+                      "devices_per_host": max(sc.worker_devices, 1),
+                      "plan_dir": os.path.join(self.out, "plans")}
         return Supervisor(
             [self.python, "-m", "tpu_dist.sim.worker",
              "--scenario", scenario_path, "--host", str(h)],
             ledger=self._ledger_path(h), policy=policy, env=env,
-            poll_s=0.1, consensus=consensus, consensus_poll_s=0.25)
+            poll_s=0.1, consensus=consensus, consensus_poll_s=0.25,
+            retune=retune)
+
+    def _planned(self) -> int:
+        """The baseline (planned) world size: standby hosts are extra
+        elastic capacity ABOVE plan, so the consensus host's first
+        resolve at the parked-standby world must not read as a shrink."""
+        return self.sc.hosts - (len(self.standby) if self.policy else 0)
 
     def _read_tick(self, h: int) -> int:
         try:
@@ -125,6 +160,96 @@ class FleetSim:
                 return int(f.read().strip() or 0)
         except (OSError, ValueError):
             return 0
+
+    # -- the autoscaling loop (round 20, obs.autoscale) -------------------
+    def _autoscale_step(self, monitor: CapacityMonitor,
+                        tailer: LedgerTailer, clock: int, live: list,
+                        peers: Dict[int, ConsensusDir], parked: set,
+                        elastic: set, gone: set, down: set,
+                        fleet_ledger: Ledger, start_host) -> None:
+        """Feed the monitor from every host's growing ledgers, evaluate
+        the policy at the fleet clock, and EXECUTE any decision through
+        the machinery that already owns capacity: consensus membership
+        (register a parked standby / leave an elastic host) whose epoch
+        bump the consensus-host supervisor turns into the shrink/expand
+        rescale — stamped with the decision id for the 1:1 pairing."""
+        sc = self.sc
+        paths = sorted(glob.glob(os.path.join(
+            glob.escape(self.out), "host*", "run*.jsonl")))
+        for rec in tailer.poll(paths):
+            monitor.observe(rec)
+        # capacity is what decisions CONTROL (parked standby out, removed
+        # hosts out) — not thread liveness: a host finishing its trace is
+        # not a scale-down, and must not re-open headroom under the max
+        capacity = sc.hosts - len(parked) - len(gone)
+        dec = monitor.evaluate(tick=clock, hosts_live=capacity)
+        if dec is None:
+            return
+        emit_decision(fleet_ledger, dec)
+        self.decisions.append(dec)
+        n = dec["target_hosts"] - dec["hosts_from"]
+        csup = self._sups.get(sc.consensus_host)
+        if dec["direction"] == "up":
+            for h in sorted(parked)[:max(n, 0)]:
+                # seed a FRESH cursor at the fleet clock: the new host
+                # serves from now on (pre-start arrivals were never
+                # admitted anywhere) and publishes its tick immediately
+                # so the fleet clock never snaps back to zero
+                base = self._ledger_path(h)
+                with open(base + ".cursor.json", "w") as f:
+                    json.dump({"tick": clock, "done": [], "fresh": True}, f)
+                with open(base + ".tick", "w") as f:
+                    f.write(f"{clock}\n")
+                if csup is not None:
+                    csup.autoscale_decision = dec["decision"]
+                peers[h].register()
+                parked.discard(h)
+                elastic.add(h)
+                start_host(h)
+        else:
+            cands = sorted((h for h in elastic
+                            if h in live and h != sc.consensus_host),
+                           reverse=True)
+            for h in cands[:max(-n, 0)]:
+                if csup is not None:
+                    csup.autoscale_decision = dec["decision"]
+                peers[h].leave()
+                down.add(h)      # the clock must not wait on it
+                gone.add(h)      # permanently out: sheds hand off
+                elastic.discard(h)
+                sup = self._sups.get(h)
+                if sup is not None:
+                    sup.request_stop()
+
+    def _handoff_step(self, gone: set, handoff_done: set,
+                      live: list) -> None:
+        """Once a permanently-removed host's drain cursor lands (it
+        carries the `shed` descriptors), append them to the lowest
+        surviving host's handoff sidecar — the worker re-admits each at
+        its scheduled tick under a `readmit` span, so no shed request is
+        lost and the request stays one trace across hosts."""
+        for h in sorted(gone - handoff_done):
+            cursor = self._ledger_path(h) + ".cursor.json"
+            try:
+                with open(cursor) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if "shed" not in doc:
+                continue        # not drained yet — retry next poll
+            handoff_done.add(h)
+            shed = [e for e in (doc.get("shed") or ())
+                    if isinstance(e, dict) and e.get("rid") is not None]
+            survivors = [s for s in live if s != h]
+            if not shed or not survivors:
+                continue
+            dst = self._ledger_path(min(survivors)) + ".handoff.jsonl"
+            try:
+                with open(dst, "a") as f:
+                    for e in shed:
+                        f.write(json.dumps({**e, "from_host": h}) + "\n")
+            except OSError:
+                pass    # the report will show the loss — never crash
 
     # -- the run ----------------------------------------------------------
     def run(self, timeout_s: Optional[float] = None) -> dict:
@@ -150,13 +275,17 @@ class FleetSim:
                           events=[dict(ev) for ev in sc.events])
 
         cdir = os.path.join(self.out, "consensus")
-        peers = {h: ConsensusDir(cdir, h, planned=sc.hosts, lease_s=3600.0)
+        peers = {h: ConsensusDir(cdir, h, planned=self._planned(),
+                                 lease_s=3600.0)
                  for h in range(sc.hosts)}
-        for c in peers.values():
-            c.register()
+        parked: set = set(self.standby) if self.policy is not None else set()
+        for h, c in peers.items():
+            if h not in parked:
+                c.register()
 
         threads: Dict[int, threading.Thread] = {}
-        for h in range(sc.hosts):
+
+        def _start_host(h: int) -> None:
             sup = self._build_supervisor(h, cdir, scenario_path)
             self._sups[h] = sup
 
@@ -168,6 +297,17 @@ class FleetSim:
             threads[h] = t
             t.start()
 
+        for h in range(sc.hosts):
+            if h not in parked:
+                _start_host(h)
+
+        monitor = (CapacityMonitor(self.policy,
+                                   hosts_live=sc.hosts - len(parked))
+                   if self.policy is not None else None)
+        tailer = LedgerTailer()
+        elastic: set = set()        # hosts an up-decision admitted
+        gone: set = set()           # hosts a down-decision removed for good
+        handoff_done: set = set()
         pending = list(self.actions)
         down: set = set()
         t_start = time.monotonic()
@@ -193,11 +333,16 @@ class FleetSim:
                 elif act.action == "register":
                     peers[act.host].register()
                     down.discard(act.host)
+            if monitor is not None and clock is not None:
+                self._autoscale_step(monitor, tailer, clock, live, peers,
+                                     parked, elastic, gone, down,
+                                     fleet_ledger, _start_host)
+                self._handoff_step(gone, handoff_done, live)
             if now - last_fleet_emit >= 1.0:
                 last_fleet_emit = now
                 fleet_ledger.emit("fleet", hosts_live=len(live),
                                   goodput_ratio=None, slo_breaches=None,
-                                  final=False)
+                                  final=False, tick=clock)
             time.sleep(0.1)
         for t in threads.values():
             t.join(timeout=max(timeout_s * 0.25, 30.0))
@@ -220,14 +365,27 @@ class FleetSim:
         with open(os.path.join(self.out, "report.json"), "w") as f:
             json.dump(report, f, indent=1, default=str)
         # the bench_track-shaped point: fleet.goodput_ratio is the gated
-        # number (tools/bench_track.py abstains on pre-fleet history)
+        # number (tools/bench_track.py abstains on pre-fleet history);
+        # autoscale_lag_ticks — burst onset to the first up decision —
+        # rides along as the lower-is-better reaction-time gate
+        burst0 = min((int(ev["tick"]) for ev in sc.events
+                      if ev["type"] == "burst"), default=None)
+        up0 = next((d["tick"] for d in self.decisions
+                    if d["direction"] == "up"), None)
+        lag = (up0 - burst0 if burst0 is not None and up0 is not None
+               else None)
         with open(os.path.join(self.out, "headline.json"), "w") as f:
             json.dump({"metric": "fleet_sim_goodput",
                        "value": acct.get("goodput_ratio"),
                        "unit": "ratio",
                        "fleet": {"goodput_ratio": acct.get("goodput_ratio"),
                                  "slo_breaches": report.get("slo_breaches"),
-                                 "hosts": sc.hosts}}, f, indent=1)
+                                 "hosts": sc.hosts,
+                                 **({"autoscale_lag_ticks": lag,
+                                     "autoscale_decisions":
+                                         len(self.decisions)}
+                                    if self.policy is not None else {})}},
+                      f, indent=1)
         return report
 
 

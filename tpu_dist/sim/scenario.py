@@ -106,6 +106,15 @@ class Scenario:
     phase: float = 0.0           # fraction of a period
     tenants: List[Tenant] = field(default_factory=list)
     events: List[Dict] = field(default_factory=list)
+    # autoscaling (round 20, obs.autoscale): {"policy": <path or inline
+    # policy doc>, "standby_hosts": [host, ...]} — standby hosts start
+    # PARKED (no worker, not registered) and join only when a scale-up
+    # decision admits them; None = fixed capacity (every host live)
+    autoscale: Optional[Dict] = None
+
+    def standby_hosts(self) -> List[int]:
+        return sorted(int(h) for h in
+                      ((self.autoscale or {}).get("standby_hosts") or ()))
 
     def rate(self, tick: int, host: int) -> float:
         """Mean arrivals for (tick, host): the diurnal curve plus any
@@ -140,7 +149,9 @@ class Scenario:
                 "tenants": [{"name": t.name, "weight": t.weight,
                              "prompt": list(t.prompt), "out": list(t.out)}
                             for t in self.tenants]},
-            "events": [dict(ev) for ev in self.events]}
+            "events": [dict(ev) for ev in self.events],
+            **({"autoscale": dict(self.autoscale)}
+               if self.autoscale is not None else {})}
 
     def wall_estimate_s(self) -> float:
         """Lower-bound wall estimate of one host's paced trace (runner
@@ -192,7 +203,9 @@ def parse_scenario(doc: Dict) -> Scenario:
         period=int(traffic.get("period", 0)),
         phase=float(traffic.get("phase", 0.0)),
         tenants=tenants,
-        events=[dict(ev) for ev in doc.get("events", [])])
+        events=[dict(ev) for ev in doc.get("events", [])],
+        autoscale=(dict(doc["autoscale"])
+                   if doc.get("autoscale") is not None else None))
     _require(sc.hosts >= 1, "hosts must be >= 1")
     _require(sc.ticks >= 1, "ticks must be >= 1")
     _require(sc.tick_s > 0, "tick_s must be > 0")
@@ -227,6 +240,20 @@ def parse_scenario(doc: Dict) -> Scenario:
                      "slow_host event needs an in-range host")
             _require(float(ev.get("factor", 0)) >= 1.0,
                      "slow_host factor must be >= 1.0")
+    if sc.autoscale is not None:
+        _require(isinstance(sc.autoscale, dict),
+                 "autoscale must be a mapping")
+        pol = sc.autoscale.get("policy")
+        _require(isinstance(pol, (str, dict)) and pol,
+                 "autoscale needs a 'policy' (path or inline document)")
+        standby = sc.standby_hosts()
+        _require(all(0 <= h < sc.hosts for h in standby),
+                 f"autoscale standby_hosts out of range {standby}")
+        _require(sc.consensus_host not in standby,
+                 f"consensus host {sc.consensus_host} cannot be standby "
+                 "(it anchors membership)")
+        _require(len(set(standby)) == len(standby),
+                 f"duplicate autoscale standby_hosts {standby}")
     return sc
 
 

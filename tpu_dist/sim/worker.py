@@ -95,19 +95,31 @@ def _cursor_path(base: str) -> str:
 
 
 def _read_cursor(base: str):
+    """(resume tick, completed rids, fresh): ``fresh`` marks a cursor the
+    RUNNER seeded for an autoscale-admitted standby host — the host did
+    not exist before its start tick, so pre-start arrivals are dropped
+    outright (they were never admitted anywhere) instead of re-admitted."""
     try:
         with open(_cursor_path(base)) as f:
             doc = json.load(f)
-        return int(doc.get("tick", 0)), set(doc.get("done", []))
+        return (int(doc.get("tick", 0)), set(doc.get("done", [])),
+                bool(doc.get("fresh")))
     except (OSError, ValueError):
-        return 0, set()
+        return 0, set(), False
 
 
-def _write_cursor(base: str, tick: int, done) -> None:
+def _write_cursor(base: str, tick: int, done, shed=None) -> None:
+    """``shed`` (drain time only) publishes the descriptors of every
+    request this host leaves unserved — rid/tenant/lengths/tick, all the
+    schedule needs — so the runner can hand them to a SURVIVING host
+    instead of dropping them (the ROADMAP-14 residue)."""
     tmp = _cursor_path(base) + ".tmp"
     try:
+        doc = {"tick": tick, "done": sorted(done)}
+        if shed is not None:
+            doc["shed"] = shed
         with open(tmp, "w") as f:
-            json.dump({"tick": tick, "done": sorted(done)}, f)
+            json.dump(doc, f)
         os.replace(tmp, _cursor_path(base))
     except OSError:
         pass  # progress bookkeeping must never kill the host
@@ -168,8 +180,10 @@ def main(argv=None) -> int:
     obs.run_start()
 
     base = args.ledger_path or ""
-    start_tick, done = _read_cursor(base) if base else (0, set())
-    arrivals = [a for a in plan.arrivals if a.rid not in done]
+    start_tick, done, fresh = (_read_cursor(base) if base
+                               else (0, set(), False))
+    arrivals = [a for a in plan.arrivals if a.rid not in done
+                and not (fresh and a.tick < start_tick)]
 
     lm = tiny_lm(**model_kw)
     params = lm.init({"params": jax.random.PRNGKey(sc.seed)},
@@ -195,12 +209,31 @@ def main(argv=None) -> int:
         comps = eng.drain(reason=reason, emit_run_end=False)
         for c in comps:
             done.add(c.rid)
+        # publish every request this host leaves unserved (queued-then-
+        # shed, not-yet-arrived, and any handed-off intake still pending):
+        # the runner re-admits them on a surviving host when this host is
+        # gone for good, or this host re-admits them itself on return
+        shed = [{"rid": a.rid, "tick": a.tick, "tenant": a.tenant,
+                 "prompt_len": a.prompt_len, "out_len": a.out_len}
+                for a in arrivals if a.rid not in done]
+        shed += [e for e in pending_handoff
+                 if e.get("rid") is not None and e["rid"] not in done]
         if base:
-            _write_cursor(base, tick, done)
+            _write_cursor(base, tick, done, shed=shed)
             _write_tick(base, tick)
         obs.run_end(status="preempted", snapshot_tick=tick,
                     completed=eng.completed, rejected=eng.rejected)
         return PREEMPT_SNAPSHOT_RC
+
+    # cross-host shed handoff (round 20): the runner appends descriptors
+    # of a permanently-gone host's unserved requests to this sidecar; the
+    # survivor admits each at its scheduled tick with a `readmit` span,
+    # so the request stays ONE trace across hosts (shared trace_ns)
+    from tpu_dist.obs.autoscale import LedgerTailer
+
+    handoff_tail = LedgerTailer()
+    handoff_path = base + ".handoff.jsonl" if base else ""
+    pending_handoff: list = []
 
     tick = start_tick
     i = 0
@@ -213,8 +246,8 @@ def main(argv=None) -> int:
     t_run0 = time.perf_counter()
     status_extra = {}
     try:
-        while (tick < sc.ticks or i < len(arrivals) or eng.queue
-               or any(s is not None for s in eng.slots)):
+        while (tick < sc.ticks or i < len(arrivals) or pending_handoff
+               or eng.queue or any(s is not None for s in eng.slots)):
             if tick > sc.ticks * 10 + 100_000:
                 raise RuntimeError(f"worker did not drain by tick {tick}")
             # coordinated preemption (SIGTERM via RunObs, or an injected
@@ -245,6 +278,39 @@ def main(argv=None) -> int:
                 eng.submit(DecodeRequest(a.rid, _prompt(a), a.out_len,
                                          tenant=a.tenant))
                 i += 1
+            if handoff_path:
+                pending_handoff.extend(
+                    e for e in handoff_tail.poll([handoff_path])
+                    if e.get("rid") is not None)
+            if pending_handoff:
+                later = []
+                for e in pending_handoff:
+                    if int(e.get("tick", 0)) > tick:
+                        later.append(e)
+                        continue
+                    rid = int(e["rid"])
+                    if rid in done:
+                        continue
+                    # the handed-off request joins ITS OWN trace: the
+                    # trace_ns is the scenario name, so this host derives
+                    # the same trace_id the origin host shed under
+                    t_now = time.monotonic()
+                    tid, sid, par = tracer.ids(rid, "readmit")
+                    obs.ledger.emit(
+                        "span", trace_id=tid, span_id=sid, parent_id=par,
+                        name="readmit", rid=rid,
+                        start=round(t_now, 6), end=round(t_now, 6),
+                        from_tick=e.get("tick"), at_tick=tick,
+                        tenant=e.get("tenant"), handoff=True,
+                        **tracer.attrs())
+                    eng.submit(DecodeRequest(
+                        rid, arrival_rng.integers(
+                            1, model_kw["vocab_size"],
+                            max(int(e.get("prompt_len") or 4), 1)
+                        ).astype(np.int32),
+                        max(int(e.get("out_len") or 2), 1),
+                        tenant=e.get("tenant")))
+                pending_handoff = later
             window_dispatch_s += time.perf_counter() - t0
             t0 = time.perf_counter()
             comps = eng.step()
